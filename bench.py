@@ -1,0 +1,141 @@
+"""Benchmark: FOOD101-like ResNet-50 training throughput, full pipeline.
+
+Measures the BASELINE metric — images/sec/chip on a FOOD101-shaped workload
+(224×224 JPEGs, 101 classes) through the complete framework path: columnar
+store → sharded read plan → threaded JPEG decode → prefetch → device_put →
+jitted DP train step. Also reports loader-stall % (north-star target <2%).
+
+``vs_baseline`` is measured against the only concrete number the reference
+repo contains: its captured 2-process DDP run logs ≈1.44–1.48 s/it at
+per-rank batch 128 (300 it ≈ 37875 rows/rank per epoch on FOOD101;
+/root/reference/README.md:164-184 and lance_map_style.py:134) ⇒ ≈87.7
+images/sec per GPU.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+"""
+
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REFERENCE_IMAGES_PER_SEC_PER_CHIP = 87.7  # README.md:164-184, batch 128 / 1.46 s
+
+
+def make_synthetic_food101(uri: str, rows: int, image_size: int = 224) -> None:
+    """FOOD101-shaped dataset: {image: JPEG binary, label: int64}
+    (schema parity: /root/reference/create_datasets/classification.py:50-53).
+    A small pool of distinct JPEGs is tiled to `rows` to bound setup time
+    while keeping decode work per row realistic."""
+    import pyarrow as pa
+    from PIL import Image
+
+    from lance_distributed_training_tpu.data import write_dataset
+
+    rng = np.random.default_rng(0)
+    pool = []
+    for _ in range(64):
+        arr = (rng.random((image_size, image_size, 3)) * 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=85)
+        pool.append(buf.getvalue())
+    images = [pool[i % len(pool)] for i in range(rows)]
+    labels = rng.integers(0, 101, rows)
+    table = pa.table(
+        {"image": pa.array(images, pa.binary()),
+         "label": pa.array(labels, pa.int64())}
+    )
+    write_dataset(table, uri, mode="overwrite", max_rows_per_file=rows // 4)
+
+
+def main() -> None:
+    import jax
+
+    from lance_distributed_training_tpu.data import (
+        ImageClassificationDecoder,
+        Dataset,
+        make_train_pipeline,
+    )
+    from lance_distributed_training_tpu.models import get_model_and_loss
+    from lance_distributed_training_tpu.parallel import (
+        get_mesh,
+        make_global_batch,
+        replicated_sharding,
+    )
+    from lance_distributed_training_tpu.trainer import (
+        TrainConfig,
+        create_train_state,
+        make_train_step,
+    )
+    from lance_distributed_training_tpu.utils.metrics import StepTimer
+
+    n_chips = len(jax.devices())
+    batch_size = int(os.environ.get("BENCH_BATCH", 128)) * n_chips
+    image_size = 224
+    warmup, measure = 3, 12
+    rows = batch_size * (warmup + measure)
+
+    tmp = tempfile.mkdtemp(prefix="ldt-bench-")
+    uri = os.path.join(tmp, "food101")
+    make_synthetic_food101(uri, rows, image_size)
+    dataset = Dataset(uri)
+
+    mesh = get_mesh()
+    model, loss_fn, _ = get_model_and_loss("classification", 101, "resnet50")
+    cfg = TrainConfig(dataset_path=uri, num_classes=101)
+    state = create_train_state(
+        jax.random.key(0), model, cfg, (1, image_size, image_size, 3)
+    )
+    state = jax.device_put(state, replicated_sharding(mesh))
+    step = make_train_step(loss_fn, mesh, augment=False)
+
+    decode = ImageClassificationDecoder(image_size=image_size)
+    pipe = make_train_pipeline(
+        dataset, "batch", batch_size, 0, 1, decode,
+        device_put_fn=lambda b: make_global_batch(b, mesh), prefetch=3,
+    )
+
+    rng = jax.random.key(1)
+    timer = StepTimer()
+    it = iter(pipe)
+    loss = None
+    t0 = None
+    for i in range(warmup + measure):
+        timer.loader_start()
+        batch = next(it)
+        timer.loader_stop()
+        timer.step_start()
+        state, loss = step(state, batch, rng)
+        if i < warmup:
+            jax.block_until_ready(loss)  # absorb compile into warmup
+        timer.step_stop()
+        if i == warmup - 1:
+            timer.reset()
+            t0 = time.perf_counter()
+    jax.block_until_ready(loss)
+    wall = time.perf_counter() - t0
+    images_per_sec = measure * batch_size / wall
+    per_chip = images_per_sec / n_chips
+
+    print(
+        json.dumps(
+            {
+                "metric": "food101_resnet50_images_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3),
+                "loader_stall_pct": round(timer.loader_stall_pct, 2),
+                "chips": n_chips,
+                "global_batch": batch_size,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

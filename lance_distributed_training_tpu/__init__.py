@@ -1,0 +1,27 @@
+"""lance_distributed_training_tpu — a TPU-native distributed data-loading +
+data-parallel training framework.
+
+Re-design (NOT a port) of ``lancedb/lance-distributed-training`` for TPU:
+
+* a Lance-isomorphic fragmented columnar store (:mod:`.data.format`) replacing
+  the upstream ``pylance`` Rust core the reference depends on,
+* sampler *plans* — pure functions over fragment row-counts
+  (:mod:`.data.samplers`) — replacing ``ShardedBatchSampler`` /
+  ``ShardedFragmentSampler`` / ``FullScanSampler``,
+* a prefetching input pipeline that materialises **global** ``jax.Array``
+  batches with a ``NamedSharding`` over a device mesh (:mod:`.data.pipeline`)
+  instead of per-rank torch tensors,
+* one mesh-aware trainer (:mod:`.trainer`) replacing the reference's four
+  near-identical torchrun driver scripts (``lance_iterable.py``,
+  ``lance_map_style.py``, ``torch_version/{iter,map}_style.py``),
+* a Flax model zoo + task registry (:mod:`.models`) replacing
+  ``modelling/get_model_and_loss.py``.
+
+Gradient synchronisation is sharding-propagated inside a jitted step function
+(XLA collectives over ICI/DCN) — the TPU-native equivalent of the reference's
+``torch.nn.parallel.DistributedDataParallel`` + NCCL.
+"""
+
+__version__ = "0.1.0"
+
+from . import data, models, ops, parallel, utils  # noqa: F401

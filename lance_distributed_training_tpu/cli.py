@@ -1,0 +1,77 @@
+"""CLI — the reference's argparse surface, one entry point instead of four.
+
+Flag-for-flag parity with ``/root/reference/lance_iterable.py:136-146`` (plus
+``--loader_style`` to select the map-style path that was a separate script,
+``lance_map_style.py:128-148``, and TPU knobs). Topology comes from JAX
+process discovery, not torchrun env vars (``lance_iterable.py:154-156``).
+
+Usage::
+
+    python -m lance_distributed_training_tpu.cli --dataset_path /data/food101 \
+        --sampler_type batch --batch_size 512 --epochs 10 --lr 0.05
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from .trainer import TrainConfig, train
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="TPU-native distributed training")
+    p.add_argument("--dataset_path", type=str, required=True)
+    p.add_argument("--task_type", type=str, default="classification")
+    p.add_argument("--num_classes", type=int, default=101)
+    p.add_argument("--sampler_type", type=str, default="batch",
+                   choices=["batch", "fragment", "full",
+                            "sharded_batch", "sharded_fragment", "full_scan"])
+    p.add_argument("--loader_style", type=str, default="iterable",
+                   choices=["iterable", "map"])
+    p.add_argument("--batch_size", type=int, default=512,
+                   help="GLOBAL batch size across all devices")
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--num_workers", type=int, default=0)
+    p.add_argument("--no_ddp", action="store_true",
+                   help="single-device debug mode (reference --no_ddp)")
+    p.add_argument("--no_wandb", action="store_true")
+    p.add_argument("--model_name", type=str, default="resnet50")
+    p.add_argument("--image_size", type=int, default=224)
+    p.add_argument("--prefetch", type=int, default=2)
+    p.add_argument("--no_augment", action="store_true")
+    p.add_argument("--eval_every", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--run_name", type=str, default=None)
+    return p
+
+
+def main(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+    config = TrainConfig(
+        dataset_path=args.dataset_path,
+        task_type=args.task_type,
+        num_classes=args.num_classes,
+        sampler_type=args.sampler_type,
+        loader_style=args.loader_style,
+        batch_size=args.batch_size,
+        epochs=args.epochs,
+        lr=args.lr,
+        momentum=args.momentum,
+        num_workers=args.num_workers,
+        no_ddp=args.no_ddp,
+        no_wandb=args.no_wandb,
+        model_name=args.model_name,
+        image_size=args.image_size,
+        prefetch=args.prefetch,
+        augment=not args.no_augment,
+        eval_every=args.eval_every,
+        seed=args.seed,
+        run_name=args.run_name,
+    )
+    return train(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Data subsystem: columnar storage, sampler plans, decode, input pipeline."""
+
+from .format import Dataset, Fragment, write_dataset  # noqa: F401
+from .samplers import (  # noqa: F401
+    ReadRange,
+    full_scan_plan,
+    sharded_batch_plan,
+    sharded_fragment_plan,
+    distributed_indices,
+    assert_equal_step_counts,
+    make_plan,
+)
+from .decode import (  # noqa: F401
+    ImageClassificationDecoder,
+    decode_tensor_image,
+    numeric_decoder,
+)
+from .pipeline import (  # noqa: F401
+    DataPipeline,
+    MapStylePipeline,
+    make_train_pipeline,
+    make_map_style_pipeline,
+)

@@ -1,0 +1,118 @@
+"""Decode hooks — the pluggable RecordBatch→tensor hot loop.
+
+These are the public customisation points the reference exposes as
+``to_tensor_fn`` (iterable path, ``/root/reference/lance_iterable.py:38-50``)
+and ``collate_fn`` (map-style path, ``lance_map_style.py:21-44``). Signature
+here: ``decode_fn(record_batch: pa.RecordBatch | pa.Table) -> dict[str,
+np.ndarray]``.
+
+Re-design of the reference's weakest link (SURVEY.md §3 hot-loop summary):
+
+* the reference does ``batch.to_pylist()`` then a per-row Python loop with
+  PIL decode + Resize(224) + ToTensor, single-threaded in the training
+  process (``lance_iterable.py:75-77``), and the map-style twin rebuilds the
+  transform ``Compose`` on every call (``lance_map_style.py:29-32``);
+* here, JPEG decode fans out over a shared thread pool (PIL releases the GIL
+  in its decode/resize C paths), the output is a **uint8 NHWC** batch — 3×
+  less host→device traffic than f32 CHW — and scale/normalize run on device,
+  fused into the first conv (:mod:`..ops.image`). No per-call allocation of
+  transform objects; the pool and buffers persist.
+"""
+
+from __future__ import annotations
+
+import io
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+__all__ = ["ImageClassificationDecoder", "decode_tensor_image", "numeric_decoder"]
+
+_POOL: Optional[ThreadPoolExecutor] = None
+
+
+def _pool() -> ThreadPoolExecutor:
+    global _POOL
+    if _POOL is None:
+        import os
+
+        _POOL = ThreadPoolExecutor(
+            max_workers=max(4, (os.cpu_count() or 8) // 2),
+            thread_name_prefix="ldt-decode",
+        )
+    return _POOL
+
+
+class ImageClassificationDecoder:
+    """JPEG-bytes + int label columns → ``{'image': u8 [B,H,W,3], 'label': i32 [B]}``.
+
+    Drop-in equivalent of the reference's ``decode_tensor_image``
+    (``/root/reference/lance_iterable.py:38-50``) over the schema written by
+    ``create_datasets/classification.py:50-53`` (``{image: binary, label:
+    int64}``), minus its inefficiencies: thread-pool decode, one persistent
+    transform, uint8 output.
+    """
+
+    def __init__(
+        self,
+        image_size: int = 224,
+        image_column: str = "image",
+        label_column: str = "label",
+    ):
+        self.image_size = image_size
+        self.image_column = image_column
+        self.label_column = label_column
+
+    def _decode_one(self, payload: bytes) -> np.ndarray:
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(payload))
+        # DCT-scaled decode: libjpeg decodes at 1/2, 1/4 or 1/8 scale when the
+        # target is smaller, typically 2-4x faster than decode-then-resize
+        # (the reference decodes at full size then resizes,
+        # lance_iterable.py:29,44-46).
+        img.draft("RGB", (self.image_size, self.image_size))
+        if img.mode != "RGB":
+            img = img.convert("RGB")
+        if img.size != (self.image_size, self.image_size):
+            img = img.resize((self.image_size, self.image_size), Image.BILINEAR)
+        return np.asarray(img, dtype=np.uint8)
+
+    def __call__(
+        self, batch: Union[pa.RecordBatch, pa.Table]
+    ) -> dict[str, np.ndarray]:
+        payloads = batch.column(self.image_column).to_pylist()
+        labels = np.asarray(
+            batch.column(self.label_column).to_numpy(zero_copy_only=False),
+            dtype=np.int32,
+        )
+        if len(payloads) >= 8:
+            images = list(_pool().map(self._decode_one, payloads))
+        else:
+            images = [self._decode_one(p) for p in payloads]
+        return {"image": np.stack(images), "label": labels}
+
+
+def decode_tensor_image(
+    batch: Union[pa.RecordBatch, pa.Table], image_size: int = 224
+) -> dict[str, np.ndarray]:
+    """Functional form, name-compatible with the reference hook."""
+    return ImageClassificationDecoder(image_size=image_size)(batch)
+
+
+def numeric_decoder(batch: Union[pa.RecordBatch, pa.Table]) -> dict[str, np.ndarray]:
+    """Decode all-numeric columnar batches (text-token / tabular datasets):
+    each column straight to numpy, fixed-size list columns to 2-D arrays."""
+    out: dict[str, np.ndarray] = {}
+    table = pa.Table.from_batches([batch]) if isinstance(batch, pa.RecordBatch) else batch
+    for name in table.column_names:
+        col = table.column(name).combine_chunks()
+        if pa.types.is_fixed_size_list(col.type):
+            flat = col.chunk(0) if isinstance(col, pa.ChunkedArray) else col
+            values = flat.values.to_numpy(zero_copy_only=False)
+            out[name] = values.reshape(len(flat), col.type.list_size)
+        else:
+            out[name] = col.to_numpy(zero_copy_only=False)
+    return out

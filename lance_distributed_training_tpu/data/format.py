@@ -1,0 +1,352 @@
+"""Fragmented columnar storage — the TPU-native stand-in for the Lance format.
+
+The reference delegates storage to the upstream ``pylance`` wheel (Rust core):
+``lance.write_dataset(reader, schema, uri, mode, max_rows_per_file)`` writes
+fragments of at most ``max_rows_per_file`` rows
+(``/root/reference/create_datasets/classification.py:55-61``), and the
+samplers drive the fragment scanner with whole-fragment sequential reads or
+row-range reads (``/root/reference/README.md:271,276-278``).
+
+This module is format-*isomorphic*, not byte-compatible: a dataset is a
+directory of Arrow IPC fragment files plus a JSON manifest. Everything the
+reference's capabilities depend on — fragment boundaries, sequential fragment
+scans, row-range reads, random-access ``take`` — is preserved; the byte layout
+is Arrow IPC so fragment reads are zero-copy memory maps (the right substrate
+for feeding pinned host buffers to TPU DMA).
+
+Layout::
+
+    <uri>/
+      manifest.json             # latest version pointer + schema + fragments
+      _versions/<n>.json        # immutable per-version manifests
+      fragments/frag-<id>.arrow # Arrow IPC file, record batches of <=chunk rows
+
+Concurrency note: readers open fragments lazily per-handle, so `Dataset`
+objects are cheap and safe to re-open inside worker threads/processes — the
+property upstream's ``SafeLanceDataset`` exists to provide
+(``/root/reference/README.md:24,60``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from dataclasses import dataclass
+from typing import Iterable, Iterator, Optional, Sequence, Union
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.ipc as ipc
+
+__all__ = ["Dataset", "Fragment", "write_dataset"]
+
+_MANIFEST = "manifest.json"
+_VERSIONS_DIR = "_versions"
+_FRAGMENT_DIR = "fragments"
+# Rows per Arrow record batch inside a fragment file. Small enough that a
+# row-range read touches few surplus rows, large enough to amortise IPC
+# framing. Range reads slice batches zero-copy.
+_DEFAULT_CHUNK = 4096
+
+
+def _schema_to_json(schema: pa.Schema) -> str:
+    """Serialize a schema via Arrow IPC (hex) so all logical types round-trip."""
+    return schema.serialize().to_pybytes().hex()
+
+
+def _schema_from_json(payload: str) -> pa.Schema:
+    return ipc.read_schema(pa.BufferReader(bytes.fromhex(payload)))
+
+
+@dataclass(frozen=True)
+class Fragment:
+    """One immutable fragment: a contiguous slab of rows in its own file."""
+
+    fragment_id: int
+    path: str
+    num_rows: int
+
+    def open(self) -> ipc.RecordBatchFileReader:
+        source = pa.memory_map(self.path, "r")
+        return ipc.open_file(source)
+
+
+class _FragmentReader:
+    """Zero-copy row-range reads over one fragment's Arrow IPC file.
+
+    Caches the memory-mapped reader and the cumulative batch row offsets, so a
+    range read costs: bisect → slice the overlapping batches (views, no copy)
+    → concat.
+    """
+
+    def __init__(self, fragment: Fragment):
+        self.fragment = fragment
+        self._reader = fragment.open()
+        counts = [
+            self._reader.get_batch(i).num_rows
+            for i in range(self._reader.num_record_batches)
+        ]
+        self._offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+
+    @property
+    def num_rows(self) -> int:
+        return int(self._offsets[-1])
+
+    def read_range(self, start: int, stop: int) -> pa.Table:
+        """Rows [start, stop) of this fragment as a table of zero-copy slices."""
+        if not (0 <= start <= stop <= self.num_rows):
+            raise IndexError(
+                f"range [{start}, {stop}) out of bounds for fragment "
+                f"{self.fragment.fragment_id} with {self.num_rows} rows"
+            )
+        if start == stop:
+            return pa.table(
+                {f.name: pa.array([], type=f.type) for f in self._reader.schema}
+            )
+        first = int(np.searchsorted(self._offsets, start, side="right")) - 1
+        last = int(np.searchsorted(self._offsets, stop, side="left"))
+        pieces = []
+        for b in range(first, last):
+            batch = self._reader.get_batch(b)
+            b_start, b_stop = int(self._offsets[b]), int(self._offsets[b + 1])
+            lo = max(start, b_start) - b_start
+            hi = min(stop, b_stop) - b_start
+            pieces.append(batch.slice(lo, hi - lo))
+        return pa.Table.from_batches(pieces, schema=self._reader.schema)
+
+    def take(self, indices: Sequence[int]) -> pa.Table:
+        """Random-access rows by fragment-local index (preserves order)."""
+        table = pa.Table.from_batches(
+            [self._reader.get_batch(i) for i in range(self._reader.num_record_batches)],
+            schema=self._reader.schema,
+        )
+        return table.take(pa.array(np.asarray(indices, dtype=np.int64)))
+
+
+class Dataset:
+    """A fragmented columnar dataset — reader side.
+
+    Capability parity with the upstream surface the reference exercises
+    (``/root/reference/README.md:271,276-278``; SURVEY.md §2.2):
+
+    * ``get_fragments()`` / ``count_rows()`` — manifest metadata
+      (cf. ``create_datasets/classification.py:63``),
+    * ``scan()`` — sequential whole-dataset or whole-fragment streaming
+      (``ShardedFragmentSampler``'s I/O-optimal path),
+    * ``read_range(fragment_id, start, stop)`` — the row-range read
+      ``ShardedBatchSampler`` relies on,
+    * ``take(indices)`` — global random access, the map-style
+      ``SafeLanceDataset.__getitem__`` path (``lance_map_style.py:54``).
+    """
+
+    def __init__(self, uri: Union[str, os.PathLike]):
+        self.uri = str(uri)
+        manifest_path = os.path.join(self.uri, _MANIFEST)
+        if not os.path.exists(manifest_path):
+            raise FileNotFoundError(f"no dataset manifest at {manifest_path}")
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        self.version: int = manifest["version"]
+        self.schema: pa.Schema = _schema_from_json(manifest["schema"])
+        self.fragments: list[Fragment] = [
+            Fragment(
+                fragment_id=frag["id"],
+                path=os.path.join(self.uri, frag["path"]),
+                num_rows=frag["num_rows"],
+            )
+            for frag in manifest["fragments"]
+        ]
+        self._row_offsets = np.concatenate(
+            [[0], np.cumsum([f.num_rows for f in self.fragments])]
+        ).astype(np.int64)
+        self._readers: dict[int, _FragmentReader] = {}
+        self._lock = threading.Lock()
+
+    # -- metadata ----------------------------------------------------------
+    def get_fragments(self) -> list[Fragment]:
+        return list(self.fragments)
+
+    def fragment_rows(self) -> list[int]:
+        """Per-fragment row counts — the sampler-plan input (SURVEY.md §7.2)."""
+        return [f.num_rows for f in self.fragments]
+
+    def count_rows(self) -> int:
+        return int(self._row_offsets[-1])
+
+    def __len__(self) -> int:
+        return self.count_rows()
+
+    # -- readers -----------------------------------------------------------
+    def _reader(self, fragment_id: int) -> _FragmentReader:
+        with self._lock:
+            reader = self._readers.get(fragment_id)
+            if reader is None:
+                reader = _FragmentReader(self.fragments[fragment_id])
+                self._readers[fragment_id] = reader
+            return reader
+
+    def read_range(self, fragment_id: int, start: int, stop: int) -> pa.Table:
+        """Rows [start, stop) of one fragment (zero-copy slices)."""
+        return self._reader(fragment_id).read_range(start, stop)
+
+    def scan(
+        self,
+        fragment_ids: Optional[Sequence[int]] = None,
+        batch_size: int = _DEFAULT_CHUNK,
+    ) -> Iterator[pa.RecordBatch]:
+        """Sequential streaming scan over (selected) fragments, in order."""
+        ids = range(len(self.fragments)) if fragment_ids is None else fragment_ids
+        for fid in ids:
+            reader = self._reader(fid)
+            for start in range(0, reader.num_rows, batch_size):
+                stop = min(start + batch_size, reader.num_rows)
+                for batch in reader.read_range(start, stop).to_batches():
+                    yield batch
+
+    def _locate(self, indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Global row index → (fragment_id, local index)."""
+        if indices.size and (
+            indices.min() < 0 or indices.max() >= self.count_rows()
+        ):
+            raise IndexError("take index out of bounds")
+        frag_ids = np.searchsorted(self._row_offsets, indices, side="right") - 1
+        local = indices - self._row_offsets[frag_ids]
+        return frag_ids, local
+
+    def take(self, indices: Sequence[int]) -> pa.Table:
+        """Random-access global rows, result in the order of ``indices``."""
+        indices = np.asarray(indices, dtype=np.int64)
+        if indices.size == 0:
+            return pa.table(
+                {f.name: pa.array([], type=f.type) for f in self.schema}
+            )
+        frag_ids, local = self._locate(indices)
+        # Gather per-fragment (grouped, order-preserving within each group),
+        # then restore the caller's order with one permutation take.
+        order = np.argsort(frag_ids, kind="stable")
+        pieces = []
+        for fid in np.unique(frag_ids):
+            group = order[frag_ids[order] == fid]
+            pieces.append(self._reader(int(fid)).take(local[group]))
+        combined = pa.concat_tables(pieces)  # row k ↔ original position order[k]
+        inverse = np.empty_like(order)
+        inverse[order] = np.arange(order.size)
+        return combined.take(pa.array(inverse))
+
+    def take_batch(self, indices: Sequence[int]) -> pa.RecordBatch:
+        return self.take(indices).combine_chunks().to_batches()[0]
+
+
+def _iter_record_batches(
+    data: Union[pa.Table, pa.RecordBatch, Iterable[pa.RecordBatch]],
+) -> Iterator[pa.RecordBatch]:
+    if isinstance(data, pa.Table):
+        yield from data.to_batches()
+    elif isinstance(data, pa.RecordBatch):
+        yield data
+    else:
+        yield from data
+
+
+def write_dataset(
+    data: Union[pa.Table, pa.RecordBatch, Iterable[pa.RecordBatch]],
+    uri: Union[str, os.PathLike],
+    schema: Optional[pa.Schema] = None,
+    mode: str = "create",
+    max_rows_per_file: int = 1024 * 1024,
+    chunk_rows: int = _DEFAULT_CHUNK,
+) -> Dataset:
+    """Streaming writer: consume record batches, shard into fragments.
+
+    API parity with ``lance.write_dataset`` as the reference exercises it
+    (``/root/reference/create_datasets/classification.py:55-61``): accepts a
+    lazy generator (never materialises the whole dataset), honours
+    ``max_rows_per_file`` as the fragment size, supports
+    ``mode='create'|'overwrite'|'append'``.
+    """
+    uri = str(uri)
+    if mode not in ("create", "overwrite", "append"):
+        raise ValueError(f"unknown mode {mode!r}")
+    manifest_path = os.path.join(uri, _MANIFEST)
+    exists = os.path.exists(manifest_path)
+    if mode == "create" and exists:
+        raise FileExistsError(f"dataset exists at {uri} (use mode='overwrite')")
+
+    prev_fragments: list[dict] = []
+    version = 1
+    if mode == "append" and exists:
+        with open(manifest_path) as f:
+            prev = json.load(f)
+        prev_fragments = prev["fragments"]
+        version = prev["version"] + 1
+        if schema is not None and _schema_to_json(schema) != prev["schema"]:
+            raise ValueError("append schema mismatch")
+        schema = _schema_from_json(prev["schema"])
+    elif mode == "overwrite" and exists:
+        with open(manifest_path) as f:
+            version = json.load(f)["version"] + 1
+
+    os.makedirs(os.path.join(uri, _FRAGMENT_DIR), exist_ok=True)
+    os.makedirs(os.path.join(uri, _VERSIONS_DIR), exist_ok=True)
+
+    next_id = (max((f["id"] for f in prev_fragments), default=-1)) + 1
+    fragments = list(prev_fragments)
+
+    writer: Optional[ipc.RecordBatchFileWriter] = None
+    frag_rows = 0
+    frag_path = ""
+
+    def _open_fragment() -> None:
+        nonlocal writer, frag_rows, frag_path, next_id
+        frag_path = os.path.join(_FRAGMENT_DIR, f"frag-{next_id:05d}.arrow")
+        writer = ipc.new_file(os.path.join(uri, frag_path), schema)
+        frag_rows = 0
+
+    def _close_fragment() -> None:
+        nonlocal writer, next_id
+        assert writer is not None
+        writer.close()
+        fragments.append({"id": next_id, "path": frag_path, "num_rows": frag_rows})
+        next_id += 1
+        writer = None
+
+    for batch in _iter_record_batches(data):
+        if schema is None:
+            schema = batch.schema
+        elif batch.schema != schema:
+            batch = batch.cast(schema)
+        cursor = 0
+        while cursor < batch.num_rows:
+            if writer is None:
+                _open_fragment()
+            room = max_rows_per_file - frag_rows
+            piece = batch.slice(cursor, min(room, batch.num_rows - cursor))
+            # Re-chunk large slices so range reads stay fine-grained.
+            for start in range(0, piece.num_rows, chunk_rows):
+                writer.write_batch(
+                    piece.slice(start, min(chunk_rows, piece.num_rows - start))
+                )
+            frag_rows += piece.num_rows
+            cursor += piece.num_rows
+            if frag_rows >= max_rows_per_file:
+                _close_fragment()
+    if writer is not None:
+        _close_fragment()
+    if schema is None:
+        raise ValueError("empty input and no schema given")
+
+    manifest = {
+        "version": version,
+        "schema": _schema_to_json(schema),
+        "fragments": fragments,
+    }
+    # Atomic manifest swap: write to temp file then rename.
+    with open(os.path.join(uri, _VERSIONS_DIR, f"{version}.json"), "w") as f:
+        json.dump(manifest, f)
+    fd, tmp = tempfile.mkstemp(dir=uri, suffix=".manifest.tmp")
+    with os.fdopen(fd, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, manifest_path)
+    return Dataset(uri)

@@ -1,0 +1,295 @@
+"""Sampler plans — pure functions from fragment row-counts to read plans.
+
+The reference uses three upstream Lance samplers plus torch's
+``DistributedSampler`` (SURVEY.md §2.2):
+
+* ``ShardedBatchSampler(rank, world_size)`` — batch-level round-robin row
+  ranges, perfectly balanced (``/root/reference/lance_iterable.py:62-63``,
+  ``README.md:127,257-271``),
+* ``ShardedFragmentSampler(rank, world_size, pad=True)`` — strided whole
+  fragments per rank; I/O-optimal, but unbalanced fragments deadlock the
+  collective (``README.md:140-157``, crash log ``:162-254``),
+* ``FullScanSampler()`` — not DP-aware, every process scans everything
+  (``lance_iterable.py:66-67``),
+* torch ``DistributedSampler`` for the map-style path
+  (``lance_map_style.py:56-58``).
+
+TPU-native re-design: samplers here are **pure functions** producing explicit
+*plans* (lists of :class:`ReadRange` per step), decoupled from any reader.
+This unifies the reference's sampler⇄dataset coupling rule
+(``README.md:274-284``) — the same plan feeds the streaming reader (iterable
+path) or the random-access ``take`` path (map-style).
+
+Each returned plan is **per-process**: step ``s`` of process ``p`` is
+``plan[s]``. The load-bearing invariant — every process emits the *same*
+number of steps, each of the *same* row count — is what prevents the
+collective-deadlock failure class on TPU exactly as on NCCL (unequal step
+counts hang ``psum``; SURVEY.md §2.4). :func:`assert_equal_step_counts`
+checks it statically at pipeline-build time (SURVEY.md §5 "race detection").
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "ReadRange",
+    "full_scan_plan",
+    "sharded_batch_plan",
+    "sharded_fragment_plan",
+    "distributed_indices",
+    "assert_equal_step_counts",
+    "make_plan",
+]
+
+
+class ReadRange(NamedTuple):
+    """Rows ``[start, stop)`` of one fragment."""
+
+    fragment: int
+    start: int
+    stop: int
+
+    @property
+    def num_rows(self) -> int:
+        return self.stop - self.start
+
+
+Plan = list[list[ReadRange]]  # plan[step] = ranges forming that step's batch
+
+
+def _global_to_ranges(
+    fragment_rows: Sequence[int], start: int, stop: int
+) -> list[ReadRange]:
+    """Global row span [start, stop) → per-fragment ranges (may straddle)."""
+    offsets = np.concatenate([[0], np.cumsum(fragment_rows)])
+    ranges = []
+    for fid in range(len(fragment_rows)):
+        lo = max(start, int(offsets[fid]))
+        hi = min(stop, int(offsets[fid + 1]))
+        if lo < hi:
+            ranges.append(ReadRange(fid, lo - int(offsets[fid]), hi - int(offsets[fid])))
+    return ranges
+
+
+def full_scan_plan(
+    fragment_rows: Sequence[int],
+    batch_size: int,
+    *,
+    drop_last: bool = False,
+) -> Plan:
+    """Every process scans the full dataset sequentially.
+
+    Parity: ``FullScanSampler`` — "not DP-aware", single-device eval/debug
+    (``/root/reference/README.md:126,130-138``).
+    """
+    total = int(sum(fragment_rows))
+    plan: Plan = []
+    for start in range(0, total, batch_size):
+        stop = min(start + batch_size, total)
+        if drop_last and stop - start < batch_size:
+            break
+        plan.append(_global_to_ranges(fragment_rows, start, stop))
+    return plan
+
+
+def sharded_batch_plan(
+    fragment_rows: Sequence[int],
+    batch_size: int,
+    process_index: int,
+    process_count: int,
+) -> Plan:
+    """Batch-level round-robin sharding — balanced by construction.
+
+    Parity: ``ShardedBatchSampler(rank, world_size)`` — global batches dealt
+    round-robin (rank 0 → batches 0, 2, 4, …), "perfectly balanced … safest
+    choice", at the cost of row-range reads instead of whole-fragment reads
+    (``/root/reference/README.md:127,257-271``).
+
+    The trailing partial global batch and the trailing un-deal-able full
+    batches are dropped so every process gets exactly the same step count.
+    """
+    _check_topology(process_index, process_count)
+    total = int(sum(fragment_rows))
+    num_batches = total // batch_size  # drop ragged tail
+    usable = (num_batches // process_count) * process_count
+    plan: Plan = []
+    for b in range(process_index, usable, process_count):
+        plan.append(
+            _global_to_ranges(fragment_rows, b * batch_size, (b + 1) * batch_size)
+        )
+    return plan
+
+
+def sharded_fragment_plan(
+    fragment_rows: Sequence[int],
+    batch_size: int,
+    process_index: int,
+    process_count: int,
+    *,
+    pad: bool = True,
+) -> Plan:
+    """Fragment-level strided sharding — I/O-optimal sequential reads.
+
+    Parity: ``ShardedFragmentSampler(rank, world_size, pad=True)`` — process
+    ``k`` reads fragments ``k, k + world_size, …`` sequentially
+    (``/root/reference/README.md:128,140-157``). With unequal fragment sizes
+    the raw assignment is unbalanced; the reference documents the resulting
+    NCCL-watchdog deadlock (``README.md:162-254``). ``pad=True`` equalises
+    step counts across processes by wrapping around the process's own rows
+    (repeating early rows), so every process emits
+    ``max_p ceil(rows_p / batch_size)`` identical-size batches. ``pad=False``
+    truncates every process to ``min_p floor(rows_p / batch_size)`` steps —
+    balanced by dropping data instead of repeating it.
+    """
+    _check_topology(process_index, process_count)
+    num_fragments = len(fragment_rows)
+    per_proc_rows = [
+        sum(fragment_rows[f] for f in range(p, num_fragments, process_count))
+        for p in range(process_count)
+    ]
+    my_fragments = list(range(process_index, num_fragments, process_count))
+    my_rows = per_proc_rows[process_index]
+
+    if pad:
+        steps = max(-(-rows // batch_size) for rows in per_proc_rows)  # ceil
+    else:
+        steps = min(rows // batch_size for rows in per_proc_rows)
+    if steps == 0:
+        return []
+    if my_rows == 0:
+        # A process with zero fragments still must emit `steps` batches or the
+        # collective hangs; wrap reads around fragment 0 of the whole dataset.
+        my_fragments = [fid for fid in range(num_fragments) if fragment_rows[fid] > 0]
+        my_rows = sum(fragment_rows[f] for f in my_fragments)
+        if my_rows == 0:
+            raise ValueError("dataset has no rows")
+
+    # Local concatenated row space over my fragments, wrap-around for padding.
+    local_rows = [fragment_rows[f] for f in my_fragments]
+    local_offsets = np.concatenate([[0], np.cumsum(local_rows)])
+
+    def local_range(start: int, stop: int) -> list[ReadRange]:
+        out = []
+        for i, fid in enumerate(my_fragments):
+            lo = max(start, int(local_offsets[i]))
+            hi = min(stop, int(local_offsets[i + 1]))
+            if lo < hi:
+                out.append(
+                    ReadRange(fid, lo - int(local_offsets[i]), hi - int(local_offsets[i]))
+                )
+        return out
+
+    plan: Plan = []
+    for s in range(steps):
+        start = s * batch_size
+        ranges: list[ReadRange] = []
+        need = batch_size
+        cursor = start % my_rows if my_rows else 0
+        # Wrap as many times as needed (tiny datasets may wrap repeatedly).
+        while need > 0:
+            span = min(need, my_rows - cursor)
+            ranges.extend(local_range(cursor, cursor + span))
+            need -= span
+            cursor = (cursor + span) % my_rows
+        plan.append(ranges)
+    return plan
+
+
+def distributed_indices(
+    num_rows: int,
+    process_index: int,
+    process_count: int,
+    *,
+    shuffle: bool = True,
+    seed: int = 0,
+    epoch: int = 0,
+    drop_last: bool = False,
+) -> np.ndarray:
+    """Map-style index sharding — torch ``DistributedSampler`` semantics.
+
+    Parity: ``DistributedSampler(dataset, num_replicas, rank, shuffle=True)``
+    (``/root/reference/lance_map_style.py:56-58``) including ``set_epoch``
+    reshuffling (``lance_map_style.py:85-86``): the permutation is seeded by
+    ``seed + epoch``; rows are padded by wrap-around (or dropped with
+    ``drop_last``) to a multiple of ``process_count`` and dealt
+    ``indices[rank::world_size]``.
+    """
+    _check_topology(process_index, process_count)
+    if shuffle:
+        rng = np.random.default_rng(seed + epoch)
+        indices = rng.permutation(num_rows)
+    else:
+        indices = np.arange(num_rows)
+    if drop_last:
+        usable = (num_rows // process_count) * process_count
+        indices = indices[:usable]
+    else:
+        target = -(-num_rows // process_count) * process_count
+        if target > num_rows:
+            indices = np.concatenate([indices, indices[: target - num_rows]])
+    return indices[process_index::process_count]
+
+
+def make_plan(
+    sampler_type: str,
+    fragment_rows: Sequence[int],
+    batch_size: int,
+    process_index: int,
+    process_count: int,
+    *,
+    pad: bool = True,
+) -> Plan:
+    """Dispatch by name — parity with ``get_sampler``'s string dispatch
+    (``/root/reference/lance_iterable.py:61-69``)."""
+    if sampler_type in ("batch", "sharded_batch"):
+        return sharded_batch_plan(
+            fragment_rows, batch_size, process_index, process_count
+        )
+    if sampler_type in ("fragment", "sharded_fragment"):
+        return sharded_fragment_plan(
+            fragment_rows, batch_size, process_index, process_count, pad=pad
+        )
+    if sampler_type in ("full", "full_scan"):
+        return full_scan_plan(fragment_rows, batch_size)
+    raise ValueError(f"Invalid sampler type: {sampler_type}")
+
+
+def assert_equal_step_counts(
+    plans: Sequence[Plan], batch_size: Optional[int] = None
+) -> None:
+    """Static deadlock check: all per-process plans must agree on step count
+    and per-step row count.
+
+    This is the build-time guard against the reference's documented failure
+    mode — fragment imbalance → ranks disagree on collective count → NCCL
+    watchdog SIGABRT (``/root/reference/README.md:159-254``). On TPU the same
+    imbalance hangs the XLA collective, so the check runs before training.
+    """
+    counts = [len(p) for p in plans]
+    if len(set(counts)) > 1:
+        raise RuntimeError(
+            f"deadlock hazard: per-process step counts differ: {counts}. "
+            "Unbalanced sharding (see reference README.md:140-157); use "
+            "sharded_batch_plan or pad=True."
+        )
+    for step in range(counts[0] if counts else 0):
+        rows = [sum(r.num_rows for r in plan[step]) for plan in plans]
+        if len(set(rows)) > 1:
+            raise RuntimeError(
+                f"deadlock hazard: step {step} row counts differ across "
+                f"processes: {rows}"
+            )
+        if batch_size is not None and rows and rows[0] != batch_size:
+            raise RuntimeError(
+                f"step {step} rows {rows[0]} != batch_size {batch_size}"
+            )
+
+
+def _check_topology(process_index: int, process_count: int) -> None:
+    if process_count < 1 or not (0 <= process_index < process_count):
+        raise ValueError(
+            f"invalid topology: process {process_index} of {process_count}"
+        )

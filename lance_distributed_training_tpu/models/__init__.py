@@ -1,0 +1,4 @@
+"""Model zoo + task registry — Flax replacement for ``modelling/``."""
+
+from .registry import get_model_and_loss  # noqa: F401
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401

@@ -1,0 +1,3 @@
+"""Device-side ops: the jitted post-decode transform path."""
+
+from .image import normalize_images, random_flip, IMAGENET_MEAN, IMAGENET_STD  # noqa: F401

@@ -1,0 +1,40 @@
+"""Jitted image ops — the on-device half of the decode/transform hot loop.
+
+The reference does resize + ``ToTensor`` (+ a commented-out ``Normalize``)
+per-row in Python/PIL on the host (``/root/reference/lance_iterable.py:28-32,
+38-50``). TPU-native split: the host decodes JPEG → fixed-size ``uint8`` NHWC
+(3× less H2D traffic than f32), and everything after the transfer — cast,
+scale, normalize, augment — runs on device where XLA fuses it into the first
+conv. These ops are designed to be called *inside* the jitted train step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["normalize_images", "random_flip", "IMAGENET_MEAN", "IMAGENET_STD"]
+
+# torchvision's ImageNet constants — the ones the reference comments out at
+# lance_iterable.py:31; applied here because they cost nothing once fused.
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def normalize_images(
+    images_u8: jax.Array,
+    mean=IMAGENET_MEAN,
+    std=IMAGENET_STD,
+    dtype=jnp.bfloat16,
+) -> jax.Array:
+    """uint8 NHWC → normalized `dtype` NHWC. Fuses into the following matmul."""
+    x = images_u8.astype(dtype) / jnp.asarray(255.0, dtype)
+    mean = jnp.asarray(mean, dtype).reshape(1, 1, 1, -1)
+    std = jnp.asarray(std, dtype).reshape(1, 1, 1, -1)
+    return (x - mean) / std
+
+
+def random_flip(rng: jax.Array, images: jax.Array) -> jax.Array:
+    """Per-image horizontal random flip (train-time augmentation)."""
+    flip = jax.random.bernoulli(rng, 0.5, (images.shape[0], 1, 1, 1))
+    return jnp.where(flip, images[:, :, ::-1, :], images)

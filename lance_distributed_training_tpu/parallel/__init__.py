@@ -1,0 +1,16 @@
+"""Mesh topology + sharding helpers — the communication-backend layer.
+
+TPU-native replacement for the reference's ``torch.distributed`` NCCL/Gloo
+backend (SURVEY.md §2.4): XLA collectives over ICI/DCN, selected by sharding
+annotations inside a jitted step — no explicit backend choice or manual
+all-reduce.
+"""
+
+from .mesh import (  # noqa: F401
+    get_mesh,
+    batch_sharding,
+    replicated_sharding,
+    make_global_batch,
+    process_topology,
+    sync_global_devices,
+)

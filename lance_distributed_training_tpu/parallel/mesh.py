@@ -1,0 +1,107 @@
+"""Device mesh + sharding utilities.
+
+Replaces the reference's process-group lifecycle
+(``dist.init_process_group`` … ``destroy_process_group``,
+``/root/reference/lance_iterable.py:79-80,131-132``) with JAX's model:
+``jax.distributed.initialize()`` once per host, a ``Mesh`` over all devices,
+and ``NamedSharding`` annotations that make XLA insert the collectives
+(gradient ``psum`` rides ICI, not host code).
+
+The mesh has a leading ``data`` axis (the reference's only parallelism is
+DDP — SURVEY.md §2.3) plus an optional trailing ``model`` axis so model
+sharding can be added without redesign.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "get_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "make_global_batch",
+    "process_topology",
+    "sync_global_devices",
+    "maybe_initialize_distributed",
+]
+
+
+def maybe_initialize_distributed() -> None:
+    """Multi-host rendezvous — the ``init_process_group`` equivalent.
+
+    Safe no-op when single-process (the reference's ``--no_ddp`` escape hatch,
+    ``lance_iterable.py:75,145,149-151``, is the default here: topology is
+    discovered, never required).
+    """
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") and jax.process_count() == 1:
+        jax.distributed.initialize()
+
+
+def get_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    data_axis: str = "data",
+    model_axis: Optional[str] = None,
+    model_parallelism: int = 1,
+) -> Mesh:
+    """Build the device mesh. Default: 1-D ``('data',)`` over all devices."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if model_axis is None or model_parallelism == 1:
+        return Mesh(np.array(devices), (data_axis,))
+    if n % model_parallelism:
+        raise ValueError(
+            f"{n} devices not divisible by model_parallelism={model_parallelism}"
+        )
+    grid = np.array(devices).reshape(n // model_parallelism, model_parallelism)
+    return Mesh(grid, (data_axis, model_axis))
+
+
+def batch_sharding(mesh: Mesh, data_axis: str = "data") -> NamedSharding:
+    """Sharding for a global batch: leading dim split over the data axis."""
+    return NamedSharding(mesh, P(data_axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (params/opt-state in pure DP)."""
+    return NamedSharding(mesh, P())
+
+
+def make_global_batch(pytree, mesh: Mesh, data_axis: str = "data"):
+    """Host numpy arrays → one *global* ``jax.Array`` batch-sharded over the mesh.
+
+    The TPU-native answer to the reference's per-rank ``.to(device)`` copies
+    (``/root/reference/lance_iterable.py:108-109``): each process contributes
+    its local shard; JAX assembles the logical global array. Works both
+    single-process (local data = global data, split across local devices) and
+    multi-process (``jax.make_array_from_process_local_data``).
+    """
+    sharding = batch_sharding(mesh, data_axis)
+
+    def _put(x):
+        x = np.asarray(x)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree_util.tree_map(_put, pytree)
+
+
+def process_topology() -> tuple[int, int]:
+    """(process_index, process_count) — torchrun's RANK/WORLD_SIZE equivalent
+    (``/root/reference/lance_iterable.py:154-156``), discovered not injected."""
+    return jax.process_index(), jax.process_count()
+
+
+def sync_global_devices(name: str = "barrier") -> None:
+    """Cross-host barrier — the ``dist.barrier()`` equivalent
+    (``/root/reference/torch_version/map_style.py:50,55``)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)

@@ -1,0 +1,3 @@
+"""Utilities: metrics logging, timing."""
+
+from .metrics import MetricLogger, StepTimer  # noqa: F401

@@ -1,0 +1,119 @@
+"""Metrics + timing — the observability layer.
+
+Parity with the reference's wandb/tqdm/print surface (SURVEY.md §5):
+process-0-gated ``wandb.init(project=…, config=…, name=…)`` with per-epoch
+logs (``/root/reference/lance_iterable.py:99-100,119-123``), a ``--no_wandb``
+kill-switch (``lance_iterable.py:146``), and run names that encode the
+(loader × sampler × backend) variant (``lance_map_style.py:80``). Falls back
+to JSONL + stdout when wandb is unavailable, and adds the driver-set BASELINE
+metrics the reference lacks: images/sec/chip and loader-stall % of step time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import jax
+
+__all__ = ["MetricLogger", "StepTimer"]
+
+
+class MetricLogger:
+    """Process-0-gated metric sink: wandb when available, else JSONL+stdout."""
+
+    def __init__(
+        self,
+        project: str = "lance-dist-training-tpu",
+        run_name: Optional[str] = None,
+        config: Optional[dict] = None,
+        enabled: bool = True,
+        jsonl_path: Optional[str] = None,
+    ):
+        self.is_main = jax.process_index() == 0
+        self.enabled = enabled and self.is_main
+        self._wandb = None
+        self._jsonl = None
+        if not self.enabled:
+            return
+        try:
+            import wandb  # type: ignore
+
+            self._wandb = wandb
+            wandb.init(project=project, config=config or {}, name=run_name)
+        except Exception:
+            self._wandb = None
+        path = jsonl_path or os.environ.get("LDT_METRICS_PATH", "metrics.jsonl")
+        try:
+            self._jsonl = open(path, "a")
+        except OSError:
+            self._jsonl = None
+
+    def log(self, metrics: dict, step: Optional[int] = None) -> None:
+        if not self.enabled:
+            return
+        record = dict(metrics)
+        if step is not None:
+            record["step"] = step
+        if self._wandb is not None:
+            self._wandb.log(metrics, step=step)
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(record) + "\n")
+            self._jsonl.flush()
+        pretty = ", ".join(
+            f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in record.items()
+        )
+        print(f"[metrics] {pretty}", flush=True)
+
+    def finish(self) -> None:
+        if self._wandb is not None:
+            self._wandb.finish()
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
+
+
+class StepTimer:
+    """Separates loader-stall time from device-step time.
+
+    The BASELINE north-star metric is "<2% of step time blocked on the
+    loader"; the reference can't measure it (only coarse epoch wall-clock,
+    ``/root/reference/lance_iterable.py:105,118``). Usage::
+
+        timer.loader_start(); batch = next(it); timer.loader_stop()
+        timer.step_start();   loss = step(batch); timer.step_stop()
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.loader_s = 0.0
+        self.step_s = 0.0
+        self.steps = 0
+        self._t = 0.0
+
+    def loader_start(self) -> None:
+        self._t = time.perf_counter()
+
+    def loader_stop(self) -> None:
+        self.loader_s += time.perf_counter() - self._t
+
+    def step_start(self) -> None:
+        self._t = time.perf_counter()
+
+    def step_stop(self) -> None:
+        self.step_s += time.perf_counter() - self._t
+        self.steps += 1
+
+    @property
+    def loader_stall_pct(self) -> float:
+        total = self.loader_s + self.step_s
+        return 100.0 * self.loader_s / total if total > 0 else 0.0
+
+    def images_per_sec(self, batch_size: int) -> float:
+        total = self.loader_s + self.step_s
+        return self.steps * batch_size / total if total > 0 else 0.0

@@ -1,0 +1,59 @@
+"""Test env: simulate an 8-device TPU mesh on CPU (SURVEY.md §4).
+
+Must run before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# The axon TPU plugin (sitecustomize) force-updates jax_platforms to
+# "axon,cpu" at interpreter start, overriding the env var — pin it back.
+jax.config.update("jax_platforms", "cpu")
+
+import io
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_jpeg(rng: np.ndarray, size: int = 32) -> bytes:
+    """A small random JPEG payload (stands in for FOOD101 images)."""
+    from PIL import Image
+
+    arr = (rng.random((size, size, 3)) * 255).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="session")
+def image_table() -> pa.Table:
+    """240-row {image: binary, label: int64} table — the schema written by the
+    reference's dataset builder (create_datasets/classification.py:50-53)."""
+    rng = np.random.default_rng(0)
+    images = [make_jpeg(rng) for _ in range(240)]
+    labels = rng.integers(0, 10, 240)
+    return pa.table(
+        {"image": pa.array(images, pa.binary()), "label": pa.array(labels, pa.int64())}
+    )
+
+
+@pytest.fixture()
+def image_dataset(tmp_path, image_table):
+    from lance_distributed_training_tpu.data import write_dataset
+
+    return write_dataset(
+        image_table, tmp_path / "ds", mode="create", max_rows_per_file=100
+    )

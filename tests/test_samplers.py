@@ -1,0 +1,155 @@
+"""Sampler-plan tests: balance invariants, coverage, the deadlock regression."""
+
+import numpy as np
+import pytest
+
+from lance_distributed_training_tpu.data import (
+    assert_equal_step_counts,
+    distributed_indices,
+    full_scan_plan,
+    sharded_batch_plan,
+    sharded_fragment_plan,
+)
+from lance_distributed_training_tpu.data.samplers import make_plan
+
+
+def rows_of(plan_step):
+    return sum(r.num_rows for r in plan_step)
+
+
+def covered(plan, fragment_rows):
+    """Set of (fragment, row) pairs a plan reads."""
+    out = set()
+    for step in plan:
+        for r in step:
+            out.update((r.fragment, i) for i in range(r.start, r.stop))
+    return out
+
+
+class TestShardedBatch:
+    # Parity: ShardedBatchSampler round-robin batches, rank0 -> 0,2,4...
+    # (reference README.md:127,257-271).
+    def test_round_robin_and_balance(self):
+        frags = [100, 100, 100]
+        plans = [sharded_batch_plan(frags, 32, p, 2) for p in range(2)]
+        assert_equal_step_counts(plans, batch_size=32)
+        # 300 rows -> 9 full batches -> 8 usable for 2 procs -> 4 each.
+        assert [len(p) for p in plans] == [4, 4]
+        # Process 0 gets global batches 0,2,4,6: first batch is rows 0..32.
+        first = plans[0][0]
+        assert first[0].fragment == 0 and first[0].start == 0 and rows_of(first) == 32
+        # Process 1's first batch is global batch 1: rows 32..64.
+        assert plans[1][0][0].start == 32
+
+    def test_disjoint_coverage(self):
+        frags = [70, 45, 95]
+        plans = [sharded_batch_plan(frags, 16, p, 4) for p in range(4)]
+        sets = [covered(p, frags) for p in plans]
+        for i in range(4):
+            for j in range(i + 1, 4):
+                assert not (sets[i] & sets[j])
+
+    def test_batch_straddles_fragments(self):
+        plan = sharded_batch_plan([10, 10, 10], 8, 1, 2)
+        # Global batch 1 = rows 8..16 -> fragment 0 rows 8..10 + fragment 1 rows 0..6.
+        assert plan[0] == [(0, 8, 10), (1, 0, 6)]
+
+
+class TestShardedFragment:
+    def test_strided_assignment(self):
+        # rank k gets fragments k, k+ws, ... (reference README.md:128,140-157)
+        frags = [50, 50, 50, 50]
+        plans = [sharded_fragment_plan(frags, 25, p, 2) for p in range(2)]
+        assert {r.fragment for s in plans[0] for r in s} == {0, 2}
+        assert {r.fragment for s in plans[1] for r in s} == {1, 3}
+        assert_equal_step_counts(plans, 25)
+
+    def test_imbalance_padded(self):
+        # THE deadlock regression (reference README.md:140-157, crash log
+        # :162-254): unequal fragment sizes -> without padding, ranks disagree
+        # on step count -> collective hang. pad=True must equalise.
+        frags = [100, 20]  # rank0: 100 rows, rank1: 20 rows
+        plans = [sharded_fragment_plan(frags, 10, p, 2, pad=True) for p in range(2)]
+        assert_equal_step_counts(plans, batch_size=10)
+        assert len(plans[0]) == len(plans[1]) == 10
+        # rank 1 wraps: reads its 20 rows five times over.
+        assert rows_of(plans[1][5]) == 10
+
+    def test_imbalance_unpadded_truncates(self):
+        frags = [100, 20]
+        plans = [sharded_fragment_plan(frags, 10, p, 2, pad=False) for p in range(2)]
+        assert_equal_step_counts(plans, 10)
+        assert len(plans[0]) == 2  # min(100//10, 20//10) = 2
+
+    def test_process_with_zero_fragments(self):
+        # 1 fragment, 2 processes: rank 1 owns nothing but must still step.
+        plans = [sharded_fragment_plan([64], 16, p, 2, pad=True) for p in range(2)]
+        assert_equal_step_counts(plans, 16)
+        assert len(plans[1]) == len(plans[0]) == 4
+
+    def test_batch_larger_than_local_rows_wraps(self):
+        plans = [sharded_fragment_plan([6, 100], 20, p, 2, pad=True) for p in range(2)]
+        assert_equal_step_counts(plans, 20)
+        assert all(rows_of(s) == 20 for s in plans[0])
+
+
+class TestFullScan:
+    def test_covers_everything_every_process(self):
+        # FullScanSampler: not DP-aware (reference README.md:126,130-138).
+        frags = [33, 67]
+        plan = full_scan_plan(frags, 25)
+        assert covered(plan, frags) == {(f, i) for f, n in enumerate(frags)
+                                        for i in range(n)}
+        assert rows_of(plan[-1]) == 100 - 3 * 25  # ragged tail kept
+
+    def test_drop_last(self):
+        plan = full_scan_plan([100], 30, drop_last=True)
+        assert len(plan) == 3 and all(rows_of(s) == 30 for s in plan)
+
+
+class TestDistributedIndices:
+    # Parity: torch DistributedSampler (reference lance_map_style.py:56-58).
+    def test_partition_and_pad(self):
+        shards = [distributed_indices(103, p, 4, shuffle=False) for p in range(4)]
+        assert all(len(s) == 26 for s in shards)  # ceil(103/4)*4 = 104, padded
+        flat = np.concatenate(shards)
+        assert set(flat.tolist()) == set(range(103))
+
+    def test_epoch_reshuffle_deterministic(self):
+        a = distributed_indices(100, 0, 2, seed=7, epoch=0)
+        b = distributed_indices(100, 0, 2, seed=7, epoch=1)
+        a2 = distributed_indices(100, 0, 2, seed=7, epoch=0)
+        assert not np.array_equal(a, b)  # set_epoch reshuffles (:85-86)
+        assert np.array_equal(a, a2)
+
+    def test_shuffled_shards_disjoint(self):
+        shards = [distributed_indices(100, p, 4, seed=3) for p in range(4)]
+        flat = np.concatenate(shards)
+        assert sorted(flat.tolist()) == sorted(range(100))
+
+    def test_drop_last(self):
+        shards = [distributed_indices(103, p, 4, shuffle=False, drop_last=True)
+                  for p in range(4)]
+        assert all(len(s) == 25 for s in shards)
+
+
+def test_make_plan_dispatch_and_invalid():
+    assert make_plan("batch", [100], 10, 0, 1)
+    assert make_plan("fragment", [100], 10, 0, 1)
+    assert make_plan("full", [100], 10, 0, 1)
+    with pytest.raises(ValueError, match="Invalid sampler type"):
+        # Error message parity: lance_iterable.py:69.
+        make_plan("bogus", [100], 10, 0, 1)
+
+
+def test_assert_equal_step_counts_raises():
+    good = [[[("f", 0, 0)]], [[("f", 0, 0)]]]
+    from lance_distributed_training_tpu.data import ReadRange
+
+    p0 = [[ReadRange(0, 0, 10)]]
+    p1 = [[ReadRange(0, 0, 10)], [ReadRange(0, 10, 20)]]
+    with pytest.raises(RuntimeError, match="deadlock"):
+        assert_equal_step_counts([p0, p1])
+    p2 = [[ReadRange(0, 0, 8)]]
+    with pytest.raises(RuntimeError, match="deadlock"):
+        assert_equal_step_counts([p0, p2])
