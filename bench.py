@@ -24,6 +24,15 @@ import time
 
 import numpy as np
 
+
+def _log(msg: str) -> None:
+    """Phase progress to stderr; stdout carries only the final JSON line."""
+    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
+
 REFERENCE_IMAGES_PER_SEC_PER_CHIP = 87.7  # README.md:164-184, batch 128 / 1.46 s
 
 
@@ -56,12 +65,21 @@ def make_synthetic_food101(uri: str, rows: int, image_size: int = 224) -> None:
 def main() -> None:
     import jax
 
+    # Persistent compile cache: the ResNet-50 train step is a multi-minute
+    # first compile on the tunneled TPU; cache it across bench runs.
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     from lance_distributed_training_tpu.data import (
         ImageClassificationDecoder,
         Dataset,
         make_train_pipeline,
     )
-    from lance_distributed_training_tpu.models import get_model_and_loss
+    from lance_distributed_training_tpu.models import get_task
     from lance_distributed_training_tpu.parallel import (
         get_mesh,
         make_global_batch,
@@ -75,24 +93,26 @@ def main() -> None:
     from lance_distributed_training_tpu.utils.metrics import StepTimer
 
     n_chips = len(jax.devices())
+    _log(f"devices: {jax.devices()}")
     batch_size = int(os.environ.get("BENCH_BATCH", 128)) * n_chips
     image_size = 224
-    warmup, measure = 3, 12
+    warmup, measure = 2, 10
     rows = batch_size * (warmup + measure)
 
     tmp = tempfile.mkdtemp(prefix="ldt-bench-")
     uri = os.path.join(tmp, "food101")
     make_synthetic_food101(uri, rows, image_size)
     dataset = Dataset(uri)
+    _log(f"dataset ready: {rows} rows")
 
     mesh = get_mesh()
-    model, loss_fn, _ = get_model_and_loss("classification", 101, "resnet50")
+    task = get_task("classification", num_classes=101, model_name="resnet50",
+                    image_size=image_size, augment=False)
     cfg = TrainConfig(dataset_path=uri, num_classes=101)
-    state = create_train_state(
-        jax.random.key(0), model, cfg, (1, image_size, image_size, 3)
-    )
+    state = create_train_state(jax.random.key(0), task, cfg)
     state = jax.device_put(state, replicated_sharding(mesh))
-    step = make_train_step(loss_fn, mesh, augment=False)
+    step = make_train_step(task, mesh)
+    _log("model state initialised")
 
     decode = ImageClassificationDecoder(image_size=image_size)
     pipe = make_train_pipeline(
@@ -114,6 +134,8 @@ def main() -> None:
         if i < warmup:
             jax.block_until_ready(loss)  # absorb compile into warmup
         timer.step_stop()
+        if i < warmup:
+            _log(f"warmup step {i} done")
         if i == warmup - 1:
             timer.reset()
             t0 = time.perf_counter()

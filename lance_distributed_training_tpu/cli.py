@@ -21,13 +21,17 @@ from .trainer import TrainConfig, train
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="TPU-native distributed training")
     p.add_argument("--dataset_path", type=str, required=True)
-    p.add_argument("--task_type", type=str, default="classification")
+    p.add_argument("--task_type", type=str, default="classification",
+                   choices=["classification", "masked_lm", "contrastive"])
     p.add_argument("--num_classes", type=int, default=101)
     p.add_argument("--sampler_type", type=str, default="batch",
                    choices=["batch", "fragment", "full",
                             "sharded_batch", "sharded_fragment", "full_scan"])
     p.add_argument("--loader_style", type=str, default="iterable",
                    choices=["iterable", "map"])
+    p.add_argument("--data_format", type=str, default="columnar",
+                   choices=["columnar", "folder"],
+                   help="folder = the file-reading control arm (torch_version/)")
     p.add_argument("--batch_size", type=int, default=512,
                    help="GLOBAL batch size across all devices")
     p.add_argument("--epochs", type=int, default=10)
@@ -37,24 +41,49 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no_ddp", action="store_true",
                    help="single-device debug mode (reference --no_ddp)")
     p.add_argument("--no_wandb", action="store_true")
-    p.add_argument("--model_name", type=str, default="resnet50")
+    p.add_argument("--model_name", type=str, default=None,
+                   help="default per task: resnet50 / bert_base / clip_resnet50_bert")
     p.add_argument("--image_size", type=int, default=224)
+    p.add_argument("--seq_len", type=int, default=128)
+    p.add_argument("--vocab_size", type=int, default=30522)
     p.add_argument("--prefetch", type=int, default=2)
     p.add_argument("--no_augment", action="store_true")
     p.add_argument("--eval_every", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--run_name", type=str, default=None)
+    p.add_argument("--backend", type=str, default=None,
+                   choices=["tpu", "cpu"],
+                   help="force a JAX platform (the BASELINE --backend knob); "
+                        "default: whatever the environment provides")
+    p.add_argument("--num_cpu_devices", type=int, default=0,
+                   help="with --backend cpu: simulate an N-device mesh")
     return p
 
 
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
+    if args.backend == "cpu":
+        import jax
+
+        # Must run before the first backend query. Overrides the platform
+        # even where a plugin (e.g. the axon TPU tunnel) has pinned
+        # jax_platforms over the JAX_PLATFORMS env var. --backend tpu is the
+        # default on TPU environments, so only "cpu" needs forcing.
+        if args.num_cpu_devices > 0:
+            try:
+                jax.config.update("jax_num_cpu_devices", args.num_cpu_devices)
+            except RuntimeError as e:
+                raise SystemExit(
+                    f"--num_cpu_devices must be set before JAX initializes: {e}"
+                )
+        jax.config.update("jax_platforms", "cpu")
     config = TrainConfig(
         dataset_path=args.dataset_path,
         task_type=args.task_type,
         num_classes=args.num_classes,
         sampler_type=args.sampler_type,
         loader_style=args.loader_style,
+        data_format=args.data_format,
         batch_size=args.batch_size,
         epochs=args.epochs,
         lr=args.lr,
@@ -64,6 +93,8 @@ def main(argv=None) -> dict:
         no_wandb=args.no_wandb,
         model_name=args.model_name,
         image_size=args.image_size,
+        seq_len=args.seq_len,
+        vocab_size=args.vocab_size,
         prefetch=args.prefetch,
         augment=not args.no_augment,
         eval_every=args.eval_every,

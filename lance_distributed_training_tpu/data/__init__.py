@@ -12,6 +12,7 @@ from .samplers import (  # noqa: F401
 )
 from .decode import (  # noqa: F401
     ImageClassificationDecoder,
+    ImageTextDecoder,
     decode_tensor_image,
     numeric_decoder,
 )
@@ -21,3 +22,9 @@ from .pipeline import (  # noqa: F401
     make_train_pipeline,
     make_map_style_pipeline,
 )
+from .authoring import (  # noqa: F401
+    create_dataset_from_image_folder,
+    create_synthetic_classification_dataset,
+    create_text_token_dataset,
+)
+from .folder import FolderDataPipeline  # noqa: F401

@@ -1,0 +1,217 @@
+"""Dataset authoring — the ``create_datasets/`` equivalent (L1 in SURVEY.md §1).
+
+The reference streams torchvision Food101, re-encodes each PIL image to JPEG
+bytes, accumulates pyarrow arrays, and writes a Lance dataset with controlled
+fragment size (``/root/reference/create_datasets/classification.py:13-63``,
+schema ``{image: binary, label: int64}`` at ``:50-53``). This module does the
+same against any on-disk image-folder tree (torchvision isn't in this
+environment), plus synthetic and text authoring for the other BASELINE
+configs.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from .format import Dataset, write_dataset
+
+__all__ = [
+    "create_dataset_from_image_folder",
+    "create_synthetic_classification_dataset",
+    "create_text_token_dataset",
+    "IMAGE_SCHEMA",
+]
+
+# Schema parity: create_datasets/classification.py:50-53.
+IMAGE_SCHEMA = pa.schema([("image", pa.binary()), ("label", pa.int64())])
+
+_IMAGE_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
+
+
+def _folder_samples(root: str) -> tuple[list[tuple[str, int]], list[str]]:
+    """ImageFolder convention: root/<class_name>/<image files>."""
+    classes = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+    )
+    samples = []
+    for label, cls in enumerate(classes):
+        cls_dir = os.path.join(root, cls)
+        for name in sorted(os.listdir(cls_dir)):
+            if os.path.splitext(name)[1].lower() in _IMAGE_EXTS:
+                samples.append((os.path.join(cls_dir, name), label))
+    return samples, classes
+
+
+def create_dataset_from_image_folder(
+    root_path: str,
+    output_path: str,
+    fragment_size: int = 12500,
+    batch_size: int = 1024,
+    reencode_jpeg_quality: Optional[int] = None,
+    shuffle_seed: Optional[int] = None,
+) -> Dataset:
+    """Image-folder tree → fragmented columnar dataset.
+
+    Mirrors ``create_lance_from_classification_dataset``
+    (``create_datasets/classification.py:13-17``): a lazy record-batch
+    generator (never holds the full dataset, ``:24-47``), batches of
+    ``batch_size`` rows, fragments capped at ``fragment_size`` rows
+    (``:55-61``). JPEG files are passed through byte-identical unless
+    ``reencode_jpeg_quality`` is set (the reference always re-encodes,
+    ``:27-29``; pass-through is strictly faster and lossless).
+    """
+    samples, classes = _folder_samples(root_path)
+    if not samples:
+        raise ValueError(f"no images under {root_path}")
+    if shuffle_seed is not None:
+        rng = np.random.default_rng(shuffle_seed)
+        samples = [samples[i] for i in rng.permutation(len(samples))]
+
+    def gen() -> Iterator[pa.RecordBatch]:
+        images, labels = [], []
+        for path, label in samples:
+            with open(path, "rb") as f:
+                payload = f.read()
+            if reencode_jpeg_quality is not None or not path.lower().endswith(
+                (".jpg", ".jpeg")
+            ):
+                from PIL import Image
+
+                img = Image.open(io.BytesIO(payload)).convert("RGB")
+                buf = io.BytesIO()
+                img.save(buf, format="JPEG",
+                         quality=reencode_jpeg_quality or 85)
+                payload = buf.getvalue()
+            images.append(payload)
+            labels.append(label)
+            if len(images) >= batch_size:
+                yield pa.record_batch(
+                    [pa.array(images, pa.binary()), pa.array(labels, pa.int64())],
+                    schema=IMAGE_SCHEMA,
+                )
+                images, labels = [], []
+        if images:
+            yield pa.record_batch(
+                [pa.array(images, pa.binary()), pa.array(labels, pa.int64())],
+                schema=IMAGE_SCHEMA,
+            )
+
+    ds = write_dataset(
+        gen(), output_path, schema=IMAGE_SCHEMA, mode="overwrite",
+        max_rows_per_file=fragment_size,
+    )
+    # Fragment-count report, as the reference prints (classification.py:63).
+    print(f"wrote {ds.count_rows()} rows in {len(ds.get_fragments())} fragments "
+          f"({len(classes)} classes)")
+    return ds
+
+
+def create_synthetic_classification_dataset(
+    output_path: str,
+    rows: int,
+    num_classes: int = 101,
+    image_size: int = 224,
+    fragment_size: int = 12500,
+    unique_images: int = 64,
+    seed: int = 0,
+    jpeg_quality: int = 85,
+) -> Dataset:
+    """FOOD101-shaped synthetic dataset for tests and benchmarks."""
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    pool = []
+    for _ in range(min(unique_images, rows)):
+        arr = (rng.random((image_size, image_size, 3)) * 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=jpeg_quality)
+        pool.append(buf.getvalue())
+
+    def gen() -> Iterator[pa.RecordBatch]:
+        done = 0
+        while done < rows:
+            n = min(4096, rows - done)
+            images = [pool[(done + i) % len(pool)] for i in range(n)]
+            labels = rng.integers(0, num_classes, n)
+            yield pa.record_batch(
+                [pa.array(images, pa.binary()), pa.array(labels, pa.int64())],
+                schema=IMAGE_SCHEMA,
+            )
+            done += n
+
+    return write_dataset(
+        gen(), output_path, schema=IMAGE_SCHEMA, mode="overwrite",
+        max_rows_per_file=fragment_size,
+    )
+
+
+def create_text_token_dataset(
+    output_path: str,
+    token_ids: Sequence[Sequence[int]],
+    seq_len: int,
+    fragment_size: int = 50000,
+    pad_id: int = 0,
+    pack: bool = True,
+) -> Dataset:
+    """Tokenised text → packed fixed-length rows (the C4/BERT BASELINE config).
+
+    Documents are greedily packed into ``seq_len`` windows (or padded, with
+    ``pack=False``) so every row is a fixed-size-list column — static shapes,
+    zero-copy to numpy, no per-row host work at train time.
+    """
+    schema = pa.schema(
+        [
+            ("input_ids", pa.list_(pa.int32(), seq_len)),
+            ("attention_mask", pa.list_(pa.int8(), seq_len)),
+        ]
+    )
+
+    def rows() -> Iterator[tuple[list[int], list[int]]]:
+        if pack:
+            buf: list[int] = []
+            for doc in token_ids:
+                buf.extend(doc)
+                while len(buf) >= seq_len:
+                    yield buf[:seq_len], [1] * seq_len
+                    buf = buf[seq_len:]
+            if buf:
+                mask = [1] * len(buf) + [0] * (seq_len - len(buf))
+                yield buf + [pad_id] * (seq_len - len(buf)), mask
+        else:
+            for doc in token_ids:
+                doc = list(doc)[:seq_len]
+                mask = [1] * len(doc) + [0] * (seq_len - len(doc))
+                yield doc + [pad_id] * (seq_len - len(doc)), mask
+
+    def gen() -> Iterator[pa.RecordBatch]:
+        ids_buf, mask_buf = [], []
+        for ids, mask in rows():
+            ids_buf.append(ids)
+            mask_buf.append(mask)
+            if len(ids_buf) >= 4096:
+                yield pa.record_batch(
+                    [
+                        pa.array(ids_buf, schema.field("input_ids").type),
+                        pa.array(mask_buf, schema.field("attention_mask").type),
+                    ],
+                    schema=schema,
+                )
+                ids_buf, mask_buf = [], []
+        if ids_buf:
+            yield pa.record_batch(
+                [
+                    pa.array(ids_buf, schema.field("input_ids").type),
+                    pa.array(mask_buf, schema.field("attention_mask").type),
+                ],
+                schema=schema,
+            )
+
+    return write_dataset(
+        gen(), output_path, schema=schema, mode="overwrite",
+        max_rows_per_file=fragment_size,
+    )

@@ -59,11 +59,22 @@ class ImageClassificationDecoder:
         self,
         image_size: int = 224,
         image_column: str = "image",
-        label_column: str = "label",
+        label_column: Optional[str] = "label",
+        use_native: bool = True,
     ):
         self.image_size = image_size
         self.image_column = image_column
         self.label_column = label_column
+        self.use_native = use_native
+        self._native = None
+        if use_native:
+            try:
+                from ..native import batch_decode_jpeg, native_available
+
+                if native_available():
+                    self._native = batch_decode_jpeg
+            except Exception:
+                self._native = None
 
     def _decode_one(self, payload: bytes) -> np.ndarray:
         from PIL import Image
@@ -80,19 +91,32 @@ class ImageClassificationDecoder:
             img = img.resize((self.image_size, self.image_size), Image.BILINEAR)
         return np.asarray(img, dtype=np.uint8)
 
-    def __call__(
-        self, batch: Union[pa.RecordBatch, pa.Table]
-    ) -> dict[str, np.ndarray]:
-        payloads = batch.column(self.image_column).to_pylist()
-        labels = np.asarray(
-            batch.column(self.label_column).to_numpy(zero_copy_only=False),
-            dtype=np.int32,
-        )
+    def decode_payloads(self, payloads: list[bytes]) -> np.ndarray:
+        """JPEG byte strings → ``[N, S, S, 3] uint8`` (native path if built)."""
+        if self._native is not None:
+            images, failed = self._native(payloads, self.image_size)
+            if failed.any():
+                # Corrupt-for-libjpeg rows: retry via the tolerant PIL path.
+                for i in np.nonzero(failed)[0]:
+                    images[i] = self._decode_one(payloads[i])
+            return images
         if len(payloads) >= 8:
             images = list(_pool().map(self._decode_one, payloads))
         else:
             images = [self._decode_one(p) for p in payloads]
-        return {"image": np.stack(images), "label": labels}
+        return np.stack(images)
+
+    def __call__(
+        self, batch: Union[pa.RecordBatch, pa.Table]
+    ) -> dict[str, np.ndarray]:
+        images = self.decode_payloads(batch.column(self.image_column).to_pylist())
+        out = {"image": images}
+        if self.label_column is not None:
+            out["label"] = np.asarray(
+                batch.column(self.label_column).to_numpy(zero_copy_only=False),
+                dtype=np.int32,
+            )
+        return out
 
 
 def decode_tensor_image(
@@ -100,6 +124,33 @@ def decode_tensor_image(
 ) -> dict[str, np.ndarray]:
     """Functional form, name-compatible with the reference hook."""
     return ImageClassificationDecoder(image_size=image_size)(batch)
+
+
+class ImageTextDecoder:
+    """Mixed-modal collate: JPEG bytes + packed token columns → one batch dict
+    (the BASELINE "LAION-subset image+caption → CLIP" config). Images via the
+    native/PIL path, token columns zero-copy via :func:`numeric_decoder`."""
+
+    def __init__(self, image_size: int = 224, image_column: str = "image"):
+        self._image = ImageClassificationDecoder(
+            image_size=image_size, image_column=image_column,
+            label_column=None,
+        )
+        self.image_column = image_column
+
+    def __call__(
+        self, batch: Union[pa.RecordBatch, pa.Table]
+    ) -> dict[str, np.ndarray]:
+        table = (
+            pa.Table.from_batches([batch])
+            if isinstance(batch, pa.RecordBatch)
+            else batch
+        )
+        out = numeric_decoder(table.drop_columns([self.image_column]))
+        out["image"] = self._image.decode_payloads(
+            table.column(self.image_column).to_pylist()
+        )
+        return out
 
 
 def numeric_decoder(batch: Union[pa.RecordBatch, pa.Table]) -> dict[str, np.ndarray]:

@@ -1,0 +1,110 @@
+"""File-based control arm — the ``torch_version/`` equivalent.
+
+The reference keeps a parallel set of torchvision drivers reading
+``ImageFolder``/``Food101`` straight from files, "deliberately
+near-isomorphic" to the Lance drivers so wandb comparisons isolate the data
+layer (``/root/reference/README.md:286-290``; ``torch_version/iter_style.py``,
+``torch_version/map_style.py``). Here the control arm is a *pipeline*, not a
+driver fork: :class:`FolderDataPipeline` yields the same batch dicts as the
+columnar pipelines and plugs into the same ``train()``, so
+columnar-vs-files is a one-flag A/B (``--data_format folder``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from .authoring import _folder_samples
+from .samplers import distributed_index_batches
+
+__all__ = ["FolderDataPipeline"]
+
+
+class FolderDataPipeline:
+    """Distributed file-reading pipeline over an image-folder tree.
+
+    Map-style semantics (``DistributedSampler``-equivalent index sharding with
+    per-epoch reshuffle, mirroring ``torch_version/map_style.py:59-61``); the
+    decode hook receives ``{image: list[bytes], label: np.ndarray}`` shaped
+    like a columnar read, so the SAME decoder classes work on both arms.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        batch_size: int,
+        process_index: int,
+        process_count: int,
+        decode_fn: Callable,
+        device_put_fn: Optional[Callable] = None,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        epoch: int = 0,
+        drop_last: bool = True,
+        prefetch: int = 2,
+    ):
+        self.samples, self.classes = _folder_samples(root)
+        if not self.samples:
+            raise ValueError(f"no images under {root}")
+        self.batch_size = batch_size
+        self.process_index = process_index
+        self.process_count = process_count
+        self.decode_fn = decode_fn
+        self.device_put_fn = device_put_fn
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = epoch
+        self.drop_last = drop_last
+        self.prefetch = prefetch
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.classes)
+
+    def _index_batches(self) -> list[np.ndarray]:
+        return distributed_index_batches(
+            len(self.samples),
+            self.batch_size,
+            self.process_index,
+            self.process_count,
+            shuffle=self.shuffle,
+            seed=self.seed,
+            epoch=self.epoch,
+            drop_last=self.drop_last,
+        )
+
+    def __len__(self) -> int:
+        return len(self._index_batches())
+
+    def _read(self, idx_batch: np.ndarray):
+        import pyarrow as pa
+
+        payloads, labels = [], []
+        for i in idx_batch:
+            path, label = self.samples[int(i)]
+            with open(path, "rb") as f:
+                payloads.append(f.read())
+            labels.append(label)
+        return pa.table(
+            {"image": pa.array(payloads, pa.binary()),
+             "label": pa.array(labels, pa.int64())}
+        )
+
+    def __iter__(self) -> Iterator[dict]:
+        from .pipeline import DataPipeline
+
+        pipe = DataPipeline(
+            dataset=None,  # read_fn closes over self.samples instead
+            plan=self._index_batches(),
+            decode_fn=self.decode_fn,
+            device_put_fn=self.device_put_fn,
+            prefetch=self.prefetch,
+            read_fn=lambda _ds, idx: self._read(idx),
+        )
+        return iter(pipe)

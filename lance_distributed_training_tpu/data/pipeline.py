@@ -32,7 +32,7 @@ from .samplers import (
     Plan,
     ReadRange,
     assert_equal_step_counts,
-    distributed_indices,
+    distributed_index_batches,
     make_plan,
 )
 
@@ -198,8 +198,9 @@ class MapStylePipeline:
         self.epoch = epoch
 
     def _index_batches(self) -> list[np.ndarray]:
-        indices = distributed_indices(
+        return distributed_index_batches(
             self.dataset.count_rows(),
+            self.batch_size,
             self.process_index,
             self.process_count,
             shuffle=self.shuffle,
@@ -207,12 +208,6 @@ class MapStylePipeline:
             epoch=self.epoch,
             drop_last=self.drop_last,
         )
-        n = len(indices)
-        steps = n // self.batch_size if self.drop_last else -(-n // self.batch_size)
-        return [
-            indices[s * self.batch_size : (s + 1) * self.batch_size]
-            for s in range(steps)
-        ]
 
     def __len__(self) -> int:
         return len(self._index_batches())

@@ -40,6 +40,7 @@ __all__ = [
     "sharded_batch_plan",
     "sharded_fragment_plan",
     "distributed_indices",
+    "distributed_index_batches",
     "assert_equal_step_counts",
     "make_plan",
 ]
@@ -231,6 +232,33 @@ def distributed_indices(
         if target > num_rows:
             indices = np.concatenate([indices, indices[: target - num_rows]])
     return indices[process_index::process_count]
+
+
+def distributed_index_batches(
+    num_rows: int,
+    batch_size: int,
+    process_index: int,
+    process_count: int,
+    *,
+    shuffle: bool = True,
+    seed: int = 0,
+    epoch: int = 0,
+    drop_last: bool = True,
+) -> list[np.ndarray]:
+    """:func:`distributed_indices` sliced into per-step batches — the shared
+    map-style batch-formation used by both the columnar and folder pipelines."""
+    indices = distributed_indices(
+        num_rows,
+        process_index,
+        process_count,
+        shuffle=shuffle,
+        seed=seed,
+        epoch=epoch,
+        drop_last=drop_last,
+    )
+    n = len(indices)
+    steps = n // batch_size if drop_last else -(-n // batch_size)
+    return [indices[s * batch_size : (s + 1) * batch_size] for s in range(steps)]
 
 
 def make_plan(

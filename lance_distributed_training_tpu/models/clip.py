@@ -1,0 +1,121 @@
+"""CLIP-style dual-tower contrastive model — the mixed-modal arm.
+
+Covers the BASELINE LAION config ("image+caption → CLIP contrastive
+(mixed-modal TPU collate)"; BASELINE.json configs[4]). Absent from the
+reference (vision-only, SURVEY.md §5); built the TPU way:
+
+* image tower: the NHWC Flax ResNet (:mod:`.resnet`) with its head acting as
+  the projection,
+* text tower: the pre-LN transformer encoder (:mod:`.transformer`,
+  ``head='none'``) with masked mean-pooling + a projection,
+* **global-batch contrastive loss for free**: the step is jitted with the
+  batch sharded ``P('data')``; the ``img @ txt.T`` similarity matrix spans
+  the full global batch, so XLA inserts the cross-device all-gather that
+  torch implementations hand-write with ``all_gather`` + ``stop_grad``
+  tricks. No per-rank negatives-only approximation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .resnet import BasicBlock, BottleneckBlock, ResNet
+from .transformer import TransformerEncoder
+
+__all__ = ["CLIP", "clip_resnet50_bert", "clip_tiny", "clip_contrastive_loss"]
+
+
+def _masked_mean(x, mask):
+    mask = mask.astype(x.dtype)[..., None]
+    total = (x * mask).sum(axis=1)
+    count = jnp.maximum(mask.sum(axis=1), 1.0)
+    return total / count
+
+
+class CLIP(nn.Module):
+    """Dual-tower model: ``__call__(batch)`` → (img_emb, txt_emb, logit_scale).
+
+    Batch keys: ``image`` (normalized NHWC), ``input_ids``,
+    ``attention_mask`` — the mixed-modal collate produced by
+    :class:`..data.decode.ImageTextDecoder`.
+    """
+
+    embed_dim: int = 512
+    image_stage_sizes: tuple = (3, 4, 6, 3)
+    image_block: Any = BottleneckBlock
+    vocab_size: int = 30522
+    text_hidden: int = 512
+    text_layers: int = 6
+    text_heads: int = 8
+    text_mlp_dim: int = 2048
+    max_len: int = 77
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, images, input_ids, attention_mask, train: bool = True):
+        img_emb = ResNet(
+            stage_sizes=self.image_stage_sizes,
+            block_cls=self.image_block,
+            num_classes=self.embed_dim,  # classification head = projection
+            dtype=self.dtype,
+            name="image_tower",
+        )(images, train=train)
+
+        hidden = TransformerEncoder(
+            vocab_size=self.vocab_size,
+            hidden_size=self.text_hidden,
+            num_layers=self.text_layers,
+            num_heads=self.text_heads,
+            mlp_dim=self.text_mlp_dim,
+            max_len=self.max_len,
+            dtype=self.dtype,
+            head="none",
+            name="text_tower",
+        )(input_ids, attention_mask, train=train)
+        txt_emb = nn.Dense(self.embed_dim, dtype=jnp.float32,
+                           param_dtype=jnp.float32, name="text_proj")(
+            _masked_mean(hidden.astype(jnp.float32), attention_mask)
+        )
+
+        img_emb = img_emb / jnp.maximum(
+            jnp.linalg.norm(img_emb, axis=-1, keepdims=True), 1e-6
+        )
+        txt_emb = txt_emb / jnp.maximum(
+            jnp.linalg.norm(txt_emb, axis=-1, keepdims=True), 1e-6
+        )
+        logit_scale = self.param(
+            "logit_scale", nn.initializers.constant(jnp.log(1 / 0.07)), ()
+        )
+        return img_emb, txt_emb, jnp.exp(logit_scale)
+
+
+def clip_contrastive_loss(img_emb, txt_emb, logit_scale):
+    """Symmetric InfoNCE over the GLOBAL batch.
+
+    Under ``P('data')`` input sharding the [B, B] similarity einsum forces the
+    all-gather; both softmax directions use the full negative set.
+    """
+    logits = logit_scale * img_emb @ txt_emb.T  # [B, B]
+    labels = jnp.arange(logits.shape[0])
+    li = -jnp.take_along_axis(
+        nn.log_softmax(logits, axis=1), labels[:, None], axis=1
+    ).mean()
+    lt = -jnp.take_along_axis(
+        nn.log_softmax(logits, axis=0), labels[None, :], axis=0
+    ).mean()
+    return 0.5 * (li + lt)
+
+
+clip_resnet50_bert = partial(
+    CLIP, embed_dim=512, image_stage_sizes=(3, 4, 6, 3),
+    image_block=BottleneckBlock, text_hidden=512, text_layers=6,
+)
+clip_tiny = partial(
+    CLIP, embed_dim=64, image_stage_sizes=(1, 1, 1, 1), image_block=BasicBlock,
+    vocab_size=1000, text_hidden=64, text_layers=2, text_heads=2,
+    text_mlp_dim=128, max_len=16,
+)

@@ -1,59 +1,40 @@
 """Task registry — ``task_type`` string → (model, loss_fn, eval_fn).
 
-Parity with ``get_model_and_loss``
-(``/root/reference/modelling/get_model_and_loss.py:4-11``): only
-``"classification"`` is registered; unknown task types raise ``ValueError``
-with the reference's message shape. Extended with a ``model_name`` knob (the
-reference hard-codes resnet50, ``modelling/classification.py:6``).
-
-loss_fn(logits, batch) -> scalar; eval_fn(logits, batch) -> per-example
-correctness (for top-1 accuracy, ``modelling/classification.py:20-32``).
+API parity with ``get_model_and_loss``
+(``/root/reference/modelling/get_model_and_loss.py:4-11``): the reference
+registers only ``"classification"`` and raises ``ValueError`` otherwise; the
+same contract is kept here (extended tasks live behind
+:func:`~.tasks.get_task`, which this delegates to).
 """
 
 from __future__ import annotations
 
 from typing import Callable
 
-import jax.numpy as jnp
-import optax
-
-from . import resnet as _resnet
+from .tasks import get_task
 
 __all__ = ["get_model_and_loss"]
-
-_RESNETS = {
-    "resnet18": _resnet.resnet18,
-    "resnet34": _resnet.resnet34,
-    "resnet50": _resnet.resnet50,
-    "resnet101": _resnet.resnet101,
-    "resnet152": _resnet.resnet152,
-}
-
-
-def _classification_loss(logits, batch) -> jnp.ndarray:
-    return optax.softmax_cross_entropy_with_integer_labels(
-        logits, batch["label"]
-    ).mean()
-
-
-def _classification_correct(logits, batch) -> jnp.ndarray:
-    """Per-example top-1 correctness — summed/averaged by the caller
-    (the ``evaluate`` equivalent, ``modelling/classification.py:20-32``)."""
-    return (jnp.argmax(logits, axis=-1) == batch["label"]).astype(jnp.float32)
 
 
 def get_model_and_loss(
     task_type: str,
     num_classes: int,
     model_name: str = "resnet50",
+    image_size: int = 224,
 ) -> tuple[object, Callable, Callable]:
-    if task_type == "classification":
-        try:
-            ctor = _RESNETS[model_name]
-        except KeyError:
-            raise ValueError(
-                f"Invalid model name: {model_name} (have {sorted(_RESNETS)})"
-            ) from None
-        model = ctor(num_classes=num_classes)
-        return model, _classification_loss, _classification_correct
-    raise ValueError(f"Invalid task type: {task_type}")
+    """Returns (flax model, loss_fn(logits, batch), eval_fn(logits, batch)).
+
+    loss_fn → scalar mean cross-entropy; eval_fn → per-example top-1
+    correctness (the ``evaluate`` contract,
+    ``/root/reference/modelling/classification.py:20-32``).
+    """
+    if task_type != "classification":
+        # Error-message parity: modelling/get_model_and_loss.py:10-11.
+        raise ValueError(f"Invalid task type: {task_type}")
+    task = get_task(
+        "classification",
+        num_classes=num_classes,
+        model_name=model_name,
+        image_size=image_size,
+    )
+    return task.model, task.loss, task.metric

@@ -1,0 +1,227 @@
+"""Task abstraction: model + forward + loss + metric as one unit.
+
+Generalises the reference's ``get_model_and_loss(task_type, num_classes) →
+(model, loss_fn, eval_fn)`` contract
+(``/root/reference/modelling/get_model_and_loss.py:4-11``) so ONE jitted
+train step serves every task family. Each task owns:
+
+* ``init_variables`` — parameter/state init,
+* ``forward(variables, batch, train, rng)`` — including device-side input
+  prep (normalize/augment for images, on-device MLM masking for text: all
+  work that the reference did per-row on host is fused into the step here),
+* ``loss(outputs, batch)`` and ``metric(outputs, batch)``.
+
+Registered: ``classification`` (reference parity), ``masked_lm`` (BASELINE
+C4/BERT config), ``contrastive`` (BASELINE LAION/CLIP config).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops.image import normalize_images, random_flip
+from . import resnet as _resnet
+from .clip import CLIP, clip_contrastive_loss, clip_resnet50_bert, clip_tiny
+from .transformer import bert_base, bert_small
+
+__all__ = ["Task", "get_task", "TASK_REGISTRY"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Task:
+    name: str
+    model: Any
+    init_variables: Callable  # (rng) -> variables
+    forward: Callable  # (variables, batch, train, rng) -> (outputs, new_state|None)
+    loss: Callable  # (outputs, batch) -> scalar
+    metric: Callable  # (outputs, batch) -> per-example float array
+    metric_name: str = "accuracy"
+
+
+# ---------------------------------------------------------------- classification
+_RESNETS = {
+    "resnet18": _resnet.resnet18,
+    "resnet34": _resnet.resnet34,
+    "resnet50": _resnet.resnet50,
+    "resnet101": _resnet.resnet101,
+    "resnet152": _resnet.resnet152,
+}
+
+
+def _classification_task(num_classes: int, model_name: str, image_size: int,
+                         augment: bool) -> Task:
+    try:
+        model = _RESNETS[model_name](num_classes=num_classes)
+    except KeyError:
+        raise ValueError(
+            f"Invalid model name: {model_name} (have {sorted(_RESNETS)})"
+        ) from None
+
+    def init_variables(rng):
+        return model.init(
+            rng, jnp.zeros((1, image_size, image_size, 3), jnp.float32),
+            train=False,
+        )
+
+    def forward(variables, batch, train, rng):
+        images = normalize_images(batch["image"])
+        if train and augment and rng is not None:
+            images = random_flip(rng, images)
+        if train:
+            logits, new_state = model.apply(
+                variables, images, train=True, mutable=["batch_stats"]
+            )
+            return logits, new_state
+        logits = model.apply(variables, images, train=False)
+        return logits, None
+
+    def loss(logits, batch):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+
+    def metric(logits, batch):
+        return (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32)
+
+    return Task("classification", model, init_variables, forward, loss, metric)
+
+
+# ---------------------------------------------------------------- masked LM
+def _masked_lm_task(vocab_size: int, model_name: str, seq_len: int,
+                    mask_prob: float = 0.15, mask_id: int = 1) -> Task:
+    ctor = {"bert_base": bert_base, "bert_small": bert_small}.get(model_name)
+    if ctor is None:
+        raise ValueError(f"Invalid model name: {model_name} "
+                         "(have ['bert_base', 'bert_small'])")
+    model = ctor(vocab_size=vocab_size, max_len=seq_len)
+
+    def init_variables(rng):
+        ids = jnp.zeros((1, seq_len), jnp.int32)
+        return model.init(rng, ids, jnp.ones((1, seq_len), jnp.int8),
+                          train=False)
+
+    def forward(variables, batch, train, rng):
+        ids = batch["input_ids"].astype(jnp.int32)
+        mask = batch["attention_mask"]
+        if train and rng is not None:
+            # On-device BERT masking: static shapes, no host RNG. The masked
+            # positions double as the loss targets.
+            mlm_mask = (
+                jax.random.bernoulli(rng, mask_prob, ids.shape)
+                & (mask > 0)
+            )
+        else:
+            # Eval: deterministic mask (every ~1/mask_prob-th position) so
+            # masked-token accuracy measures real infilling, not copying.
+            stride = max(int(round(1.0 / mask_prob)), 1)
+            positions = jnp.arange(ids.shape[1])
+            mlm_mask = ((positions % stride) == 0)[None, :] & (mask > 0)
+        corrupted = jnp.where(mlm_mask, mask_id, ids)
+        logits = model.apply(variables, corrupted, mask, train=train)
+        return (logits, mlm_mask), None
+
+    def loss(outputs, batch):
+        logits, mlm_mask = outputs
+        targets = batch["input_ids"].astype(jnp.int32)
+        raw = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        w = mlm_mask.astype(jnp.float32)
+        return (raw * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+    def metric(outputs, batch):
+        logits, mlm_mask = outputs
+        targets = batch["input_ids"].astype(jnp.int32)
+        hit = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+        w = mlm_mask.astype(jnp.float32)
+        # Per-example masked-token accuracy.
+        return (hit * w).sum(-1) / jnp.maximum(w.sum(-1), 1.0)
+
+    return Task("masked_lm", model, init_variables, forward, loss, metric,
+                metric_name="masked_token_accuracy")
+
+
+# ---------------------------------------------------------------- contrastive
+def _contrastive_task(model_name: str, image_size: int, seq_len: int,
+                      vocab_size: Optional[int], augment: bool = True) -> Task:
+    ctor = {"clip_resnet50_bert": clip_resnet50_bert, "clip_tiny": clip_tiny}.get(
+        model_name
+    )
+    if ctor is None:
+        raise ValueError(f"Invalid model name: {model_name} "
+                         "(have ['clip_resnet50_bert', 'clip_tiny'])")
+    kwargs = {"max_len": seq_len}
+    if vocab_size is not None:
+        kwargs["vocab_size"] = vocab_size
+    model: CLIP = ctor(**kwargs)
+
+    def init_variables(rng):
+        return model.init(
+            rng,
+            jnp.zeros((2, image_size, image_size, 3), jnp.float32),
+            jnp.zeros((2, seq_len), jnp.int32),
+            jnp.ones((2, seq_len), jnp.int8),
+            train=False,
+        )
+
+    def forward(variables, batch, train, rng):
+        images = normalize_images(batch["image"])
+        if train and augment and rng is not None:
+            images = random_flip(rng, images)
+        if train:
+            out, new_state = model.apply(
+                variables, images, batch["input_ids"].astype(jnp.int32),
+                batch["attention_mask"], train=True, mutable=["batch_stats"],
+            )
+            return out, new_state
+        out = model.apply(
+            variables, images, batch["input_ids"].astype(jnp.int32),
+            batch["attention_mask"], train=False,
+        )
+        return out, None
+
+    def loss(outputs, batch):
+        img_emb, txt_emb, scale = outputs
+        return clip_contrastive_loss(img_emb, txt_emb, scale)
+
+    def metric(outputs, batch):
+        img_emb, txt_emb, scale = outputs
+        logits = img_emb @ txt_emb.T
+        return (jnp.argmax(logits, -1) == jnp.arange(logits.shape[0])).astype(
+            jnp.float32
+        )
+
+    return Task("contrastive", model, init_variables, forward, loss, metric,
+                metric_name="retrieval_top1")
+
+
+def get_task(
+    task_type: str,
+    *,
+    num_classes: int = 101,
+    model_name: Optional[str] = None,
+    image_size: int = 224,
+    seq_len: int = 128,
+    vocab_size: int = 30522,
+    augment: bool = True,
+) -> Task:
+    if task_type == "classification":
+        return _classification_task(
+            num_classes, model_name or "resnet50", image_size, augment
+        )
+    if task_type == "masked_lm":
+        return _masked_lm_task(vocab_size, model_name or "bert_base", seq_len)
+    if task_type == "contrastive":
+        return _contrastive_task(
+            model_name or "clip_resnet50_bert", image_size, seq_len,
+            vocab_size if model_name != "clip_tiny" else None,
+            augment=augment,
+        )
+    # Error-message parity: modelling/get_model_and_loss.py:10-11.
+    raise ValueError(f"Invalid task type: {task_type}")
+
+
+TASK_REGISTRY = ("classification", "masked_lm", "contrastive")

@@ -1,0 +1,139 @@
+"""Flax transformer encoder (BERT-base family) — the text arm.
+
+Covers the BASELINE text config ("C4 text → on-device tokenize/pack for
+BERT-base"; BASELINE.json configs[3]). The reference itself has no text
+models (SURVEY.md §5 "vision classification only") — this extends the task
+registry the same way ``modelling/get_model_and_loss.py`` would have.
+
+TPU-first: bf16 compute / f32 params, static shapes (packed fixed-length
+sequences from :func:`..data.authoring.create_text_token_dataset`), attention
+as batched einsums on the MXU, optional remat for long sequences. The
+attention core is factored out (:func:`dot_product_attention`) so the
+sequence-parallel ring variant (:mod:`..parallel.ring_attention`) can swap in.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["TransformerEncoder", "bert_base", "bert_small", "dot_product_attention"]
+
+
+def dot_product_attention(q, k, v, mask=None, dtype=jnp.bfloat16):
+    """Standard softmax attention: q,k,v [B, H, S, D] → [B, H, S, D].
+
+    Softmax statistics in f32 for stability; matmuls in ``dtype`` on the MXU.
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(d).astype(jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    weights = nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(dtype), v)
+
+
+class SelfAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        b, s, h = x.shape
+        head_dim = h // self.num_heads
+        dense = partial(
+            nn.DenseGeneral, dtype=self.dtype, param_dtype=jnp.float32
+        )
+        q = dense(features=(self.num_heads, head_dim), name="query")(x)
+        k = dense(features=(self.num_heads, head_dim), name="key")(x)
+        v = dense(features=(self.num_heads, head_dim), name="value")(x)
+        # [B, S, H, D] -> [B, H, S, D]
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        attn = self.attention_fn or partial(dot_product_attention, dtype=self.dtype)
+        out = attn(q, k, v, mask=mask)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, h)
+        return dense(features=h, axis=-1, name="out")(out)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        norm = partial(nn.LayerNorm, dtype=self.dtype, param_dtype=jnp.float32)
+        y = norm(name="ln_attn")(x)
+        y = SelfAttention(self.num_heads, self.dtype,
+                          attention_fn=self.attention_fn, name="attn")(y, mask)
+        x = x + y
+        y = norm(name="ln_mlp")(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(x.shape[-1], dtype=self.dtype, param_dtype=jnp.float32,
+                     name="mlp_out")(y)
+        return x + y
+
+
+class TransformerEncoder(nn.Module):
+    """Pre-LN BERT-style encoder with an MLM head.
+
+    ``__call__(input_ids, attention_mask, train)`` → logits ``[B, S, vocab]``
+    (tied to the input embedding — standard weight tying keeps the head off
+    the parameter budget).
+    """
+
+    vocab_size: int
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    attention_fn: Optional[Callable] = None
+    head: str = "mlm"  # "mlm" → tied vocab logits; "none" → hidden states
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, train: bool = True):
+        b, s = input_ids.shape
+        embed = nn.Embed(self.vocab_size, self.hidden_size,
+                         param_dtype=jnp.float32, name="tok_embed")
+        pos_embed = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (self.max_len, self.hidden_size), jnp.float32,
+        )
+        x = embed(input_ids).astype(self.dtype)
+        x = x + pos_embed[:s].astype(self.dtype)
+
+        mask = None
+        if attention_mask is not None:
+            # [B, S] -> [B, 1, 1, S]: keys masked out, broadcast over queries.
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        block = EncoderBlock
+        if self.remat:
+            block = nn.remat(EncoderBlock, static_argnums=())
+        for i in range(self.num_layers):
+            x = block(self.num_heads, self.mlp_dim, self.dtype,
+                      attention_fn=self.attention_fn, name=f"layer_{i}")(x, mask)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="ln_final")(x)
+        if self.head == "none":
+            return x  # final hidden states [B, S, H] (e.g. the CLIP text tower)
+        # Tied MLM head: project back onto the embedding table.
+        logits = embed.attend(x.astype(jnp.float32))
+        return logits
+
+
+bert_base = partial(TransformerEncoder, hidden_size=768, num_layers=12,
+                    num_heads=12, mlp_dim=3072)
+bert_small = partial(TransformerEncoder, hidden_size=256, num_layers=4,
+                     num_heads=4, mlp_dim=1024)

@@ -1,0 +1,9 @@
+"""Native (C++) components and their ctypes bindings.
+
+The reference's only native code is upstream pylance's Rust core (SURVEY.md
+§2.2); here the native hot path is a libjpeg batch decoder with a C++ thread
+pool (:mod:`.jpeg`), built lazily with g++ on first use and falling back to
+the pure-Python PIL path when unavailable.
+"""
+
+from .jpeg import batch_decode_jpeg, native_available  # noqa: F401

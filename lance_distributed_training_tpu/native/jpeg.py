@@ -1,0 +1,116 @@
+"""ctypes binding + lazy build for the native batch JPEG decoder.
+
+No pybind11 in this environment; the C ABI (`ldt_decode_batch`) is bound via
+ctypes. The shared library is compiled from ``ldt_decode.cpp`` on first use
+(cached next to the source); any failure degrades gracefully to the PIL path
+in :mod:`..data.decode`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["batch_decode_jpeg", "native_available"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "ldt_decode.cpp")
+_LIB_PATH = os.path.join(_HERE, "_ldt_decode.so")
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+        _SRC, "-o", _LIB_PATH, "-ljpeg", "-pthread",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("LDT_DISABLE_NATIVE"):
+            _load_failed = True
+            return None
+        needs_build = not os.path.exists(_LIB_PATH) or (
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+        )
+        if needs_build and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            if lib.ldt_decode_abi_version() != _ABI_VERSION:
+                if not _build():
+                    _load_failed = True
+                    return None
+                lib = ctypes.CDLL(_LIB_PATH)
+            lib.ldt_decode_batch.restype = ctypes.c_int
+            lib.ldt_decode_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int,
+            ]
+            _lib = lib
+        except OSError:
+            _load_failed = True
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def batch_decode_jpeg(
+    payloads: Sequence[bytes],
+    out_size: int,
+    n_threads: int = 0,
+    out: Optional[np.ndarray] = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Decode a batch of JPEG byte strings to ``[N, S, S, 3] uint8``.
+
+    Returns ``(images, failed_mask)``; failed slots are zero-filled (caller
+    may re-decode them via PIL). Raises ``RuntimeError`` if the native
+    library is unavailable — check :func:`native_available` first.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native decoder unavailable")
+    n = len(payloads)
+    if out is None:
+        out = np.empty((n, out_size, out_size, 3), dtype=np.uint8)
+    if n == 0:
+        return out, np.zeros(0, np.uint8)
+    srcs = (ctypes.c_char_p * n)(*payloads)
+    lens = (ctypes.c_size_t * n)(*[len(p) for p in payloads])
+    failed = np.zeros(n, dtype=np.uint8)
+    lib.ldt_decode_batch(
+        ctypes.cast(srcs, ctypes.POINTER(ctypes.c_char_p)),
+        ctypes.cast(lens, ctypes.POINTER(ctypes.c_size_t)),
+        n,
+        out_size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        failed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n_threads,
+    )
+    return out, failed

@@ -14,3 +14,4 @@ from .mesh import (  # noqa: F401
     process_topology,
     sync_global_devices,
 )
+from .ring_attention import make_ring_attention, ring_attention  # noqa: F401

@@ -5,15 +5,17 @@ The reference duplicates a near-identical DDP loop across
 ``torch_version/iter_style.py:80-145`` and ``torch_version/map_style.py:85-149``
 (SURVEY.md §1: "four parallel driver scripts, not one framework entry
 point"). Here there is ONE ``train()`` with a pluggable input pipeline
-(loader style × sampler are config, not scripts).
+(loader style × sampler × data format are config, not scripts) and a
+pluggable :class:`~.models.tasks.Task` (classification / masked-LM /
+contrastive).
 
 TPU-native loop design vs. the reference hot loop (SURVEY.md §3.4):
 
 * gradient sync: no DDP wrapper — the step is jitted with a replicated state
   sharding and a ``P('data')`` batch sharding; XLA inserts the gradient
   all-reduce (psum) over ICI,
-* normalization/augment run on device fused into the step
-  (:mod:`.ops.image`), not per-row on host,
+* input prep (normalize/augment/MLM-masking) runs on device fused into the
+  step (:mod:`.ops.image`, :mod:`.models.tasks`), not per-row on host,
 * no per-step ``loss.item()`` D2H sync (``lance_iterable.py:115``): the loss
   stays on device in a running accumulator and is fetched once per epoch,
 * loader-stall is measured explicitly (BASELINE metric) by timing
@@ -25,20 +27,17 @@ from __future__ import annotations
 import dataclasses
 import time
 from functools import partial
-from typing import Any, Callable, Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 from flax.training import train_state
-from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .data.decode import ImageClassificationDecoder
+from .data.decode import ImageClassificationDecoder, numeric_decoder
 from .data.format import Dataset
 from .data.pipeline import MapStylePipeline, make_train_pipeline
-from .models import get_model_and_loss
-from .ops.image import normalize_images, random_flip
+from .models.tasks import Task, get_task
 from .parallel.mesh import (
     batch_sharding,
     get_mesh,
@@ -49,11 +48,19 @@ from .parallel.mesh import (
 )
 from .utils.metrics import MetricLogger, StepTimer
 
-__all__ = ["TrainConfig", "TrainState", "train", "make_train_step", "evaluate"]
+__all__ = [
+    "TrainConfig",
+    "TrainState",
+    "train",
+    "create_train_state",
+    "make_train_step",
+    "make_eval_step",
+    "evaluate",
+]
 
 
 class TrainState(train_state.TrainState):
-    """TrainState + mutable batch-norm statistics."""
+    """TrainState + mutable batch-norm statistics (None for stateless models)."""
 
     batch_stats: Any = None
 
@@ -61,13 +68,14 @@ class TrainState(train_state.TrainState):
 @dataclasses.dataclass
 class TrainConfig:
     """Flag-for-flag parity with the reference CLI
-    (``/root/reference/lance_iterable.py:136-146``) plus TPU knobs."""
+    (``/root/reference/lance_iterable.py:136-146``) plus TPU/task knobs."""
 
     dataset_path: str
     task_type: str = "classification"
     num_classes: int = 101
     sampler_type: str = "batch"  # batch | fragment | full (lance_iterable.py:61-69)
     loader_style: str = "iterable"  # iterable | map  (the two reference paths)
+    data_format: str = "columnar"  # columnar | folder (the torch_version/ control arm)
     batch_size: int = 512  # GLOBAL batch (reference default, lance_iterable.py:141)
     epochs: int = 10
     lr: float = 0.05
@@ -75,8 +83,10 @@ class TrainConfig:
     num_workers: int = 0  # decode threads are pooled; kept for CLI parity
     no_ddp: bool = False  # single-device escape hatch (lance_iterable.py:145)
     no_wandb: bool = False  # lance_iterable.py:146
-    model_name: str = "resnet50"
+    model_name: Optional[str] = None  # default per task (resnet50 / bert_base / clip)
     image_size: int = 224
+    seq_len: int = 128  # masked_lm / contrastive text length
+    vocab_size: int = 30522
     prefetch: int = 2
     augment: bool = True
     eval_at_end: bool = True  # rank-0 eval over train loader (lance_iterable.py:125-127)
@@ -86,95 +96,106 @@ class TrainConfig:
     log_every: int = 50
 
 
-def create_train_state(
-    rng: jax.Array, model, config: TrainConfig, sample_shape
-) -> TrainState:
-    variables = model.init(rng, jnp.zeros(sample_shape, jnp.float32), train=False)
+def _task_from_config(config: TrainConfig) -> Task:
+    return get_task(
+        config.task_type,
+        num_classes=config.num_classes,
+        model_name=config.model_name,
+        image_size=config.image_size,
+        seq_len=config.seq_len,
+        vocab_size=config.vocab_size,
+        augment=config.augment,
+    )
+
+
+def create_train_state(rng: jax.Array, task: Task, config: TrainConfig) -> TrainState:
+    variables = task.init_variables(rng)
     tx = optax.sgd(config.lr, momentum=config.momentum)
     return TrainState.create(
-        apply_fn=model.apply,
+        apply_fn=None,
         params=variables["params"],
         batch_stats=variables.get("batch_stats"),
         tx=tx,
     )
 
 
-def make_train_step(
-    loss_fn: Callable,
-    mesh,
-    *,
-    augment: bool = True,
-    donate: bool = True,
-):
+def _variables(state: TrainState) -> dict:
+    v = {"params": state.params}
+    if state.batch_stats is not None:
+        v["batch_stats"] = state.batch_stats
+    return v
+
+
+def make_train_step(task: Task, mesh, *, donate: bool = True):
     """Build the jitted DP train step.
 
-    State is replicated (``P()``), batch sharded ``P('data')``; under those
-    in-shardings XLA turns the per-shard gradients into a mean via an
-    all-reduce over ICI — the compiled equivalent of DDP's bucketed NCCL
-    all-reduce (``/root/reference/lance_iterable.py:93-97`` wrap; all-reduce
-    evidence ``README.md:185``).
+    State is replicated (``P()``), every batch leaf sharded ``P('data')`` on
+    its leading dim; under those in-shardings XLA turns the per-shard
+    gradients into a mean via an all-reduce over ICI — the compiled
+    equivalent of DDP's bucketed NCCL all-reduce
+    (``/root/reference/lance_iterable.py:93-97``; ``README.md:185``).
     """
 
     def step(state: TrainState, batch, rng):
-        images = normalize_images(batch["image"])
-        if augment:
-            images = random_flip(rng, images)
-
         def loss_of(params):
-            logits, new_model_state = state.apply_fn(
-                {"params": params, "batch_stats": state.batch_stats},
-                images,
-                train=True,
-                mutable=["batch_stats"],
-            )
-            return loss_fn(logits, batch), new_model_state["batch_stats"]
+            variables = dict(_variables(state), params=params)
+            outputs, new_state = task.forward(variables, batch, True, rng)
+            return task.loss(outputs, batch), new_state
 
-        (loss, new_batch_stats), grads = jax.value_and_grad(
+        (loss, new_model_state), grads = jax.value_and_grad(
             loss_of, has_aux=True
         )(state.params)
         state = state.apply_gradients(grads=grads)
-        state = state.replace(batch_stats=new_batch_stats)
+        if new_model_state is not None and "batch_stats" in new_model_state:
+            state = state.replace(batch_stats=new_model_state["batch_stats"])
         return state, loss
 
     repl = replicated_sharding(mesh)
     data = batch_sharding(mesh)
     return jax.jit(
         step,
-        in_shardings=(repl, {"image": data, "label": data}, repl),
+        in_shardings=(repl, data, repl),
         out_shardings=(repl, repl),
         donate_argnums=(0,) if donate else (),
     )
 
 
-def make_eval_step(correct_fn: Callable, mesh):
+def make_eval_step(task: Task, mesh):
     repl = replicated_sharding(mesh)
     data = batch_sharding(mesh)
 
     def step(state: TrainState, batch):
-        images = normalize_images(batch["image"])
-        logits = state.apply_fn(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            images,
-            train=False,
-        )
-        return correct_fn(logits, batch).sum()
+        outputs, _ = task.forward(_variables(state), batch, False, None)
+        return task.metric(outputs, batch).sum()
 
-    return jax.jit(step, in_shardings=(repl, {"image": data, "label": data}),
-                   out_shardings=repl)
+    return jax.jit(step, in_shardings=(repl, data), out_shardings=repl)
 
 
 def evaluate(state, loader, eval_step) -> float:
-    """Top-1 accuracy over a loader — parity with ``evaluate``
+    """Mean per-example metric over a loader — the ``evaluate`` equivalent
     (``/root/reference/modelling/classification.py:20-32``)."""
     correct = 0.0
     total = 0
     for batch in loader:
         correct += float(eval_step(state, batch))
-        total += batch["label"].shape[0]
+        first = jax.tree_util.tree_leaves(batch)[0]
+        total += first.shape[0]
     return correct / total if total else 0.0
 
 
-def _build_loader(config: TrainConfig, dataset: Dataset, mesh, epoch: int = 0):
+def _decoder_for(config: TrainConfig):
+    if config.task_type == "classification":
+        return ImageClassificationDecoder(image_size=config.image_size)
+    if config.task_type == "masked_lm":
+        return numeric_decoder
+    if config.task_type == "contrastive":
+        from .data.decode import ImageTextDecoder
+
+        return ImageTextDecoder(image_size=config.image_size)
+    raise ValueError(f"Invalid task type: {config.task_type}")
+
+
+def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0):
     process_index, process_count = process_topology()
     per_process = config.batch_size // process_count
     if per_process * process_count != config.batch_size:
@@ -182,8 +203,27 @@ def _build_loader(config: TrainConfig, dataset: Dataset, mesh, epoch: int = 0):
             f"global batch {config.batch_size} not divisible by "
             f"{process_count} processes"
         )
-    decode = ImageClassificationDecoder(image_size=config.image_size)
+    decode = _decoder_for(config)
     put = partial(make_global_batch, mesh=mesh)
+    if config.data_format == "folder":
+        # Control arm: plain files, no columnar store (torch_version/ twin,
+        # reference README.md:286-290).
+        from .data.folder import FolderDataPipeline
+
+        loader = FolderDataPipeline(
+            config.dataset_path,
+            per_process,
+            process_index,
+            process_count,
+            decode,
+            put,
+            seed=config.seed,
+            epoch=epoch,
+            prefetch=config.prefetch,
+        )
+        if len(loader) == 0:
+            raise ValueError("folder smaller than one global batch")
+        return loader
     if config.loader_style == "map":
         loader = MapStylePipeline(
             dataset,
@@ -223,28 +263,24 @@ def train(config: TrainConfig) -> dict:
         devices = devices[:1]
     mesh = get_mesh(devices)
 
-    dataset = Dataset(config.dataset_path)
-    model, loss_fn, correct_fn = get_model_and_loss(
-        config.task_type, config.num_classes, config.model_name
+    dataset = (
+        Dataset(config.dataset_path) if config.data_format == "columnar" else None
     )
+    task = _task_from_config(config)
 
     rng = jax.random.key(config.seed)
     rng, init_rng = jax.random.split(rng)
-    state = create_train_state(
-        init_rng,
-        model,
-        config,
-        (1, config.image_size, config.image_size, 3),
-    )
+    state = create_train_state(init_rng, task, config)
     state = jax.device_put(state, replicated_sharding(mesh))
 
-    train_step = make_train_step(loss_fn, mesh, augment=config.augment)
-    eval_step = make_eval_step(correct_fn, mesh)
+    train_step = make_train_step(task, mesh)
+    eval_step = make_eval_step(task, mesh)
 
     n_devices = len(mesh.devices.flatten())
     logger = MetricLogger(
         run_name=config.run_name
-        or f"DP-{config.loader_style}-{config.sampler_type}-{config.model_name}",
+        or f"DP-{config.loader_style}-{config.sampler_type}-"
+           f"{config.model_name or task.name}",
         config=dataclasses.asdict(config),
         enabled=not config.no_wandb,
     )
@@ -293,7 +329,7 @@ def train(config: TrainConfig) -> dict:
 
     results["total_time"] = time.perf_counter() - total_start
     if config.eval_at_end:
-        # Rank-0-style final eval over the train loader, as the reference does
+        # Final eval over the train loader, as the reference does
         # (lance_iterable.py:125-127) — here all processes participate since
         # eval is itself a sharded computation.
         loader = _build_loader(config, dataset, mesh, 0)

@@ -1,3 +1,4 @@
-"""Utilities: metrics logging, timing."""
+"""Utilities: metrics logging, timing, checkpointing, profiling."""
 
 from .metrics import MetricLogger, StepTimer  # noqa: F401
+from .profiling import StepProfile, annotate, trace  # noqa: F401

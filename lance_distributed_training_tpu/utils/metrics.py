@@ -32,19 +32,24 @@ class MetricLogger:
         enabled: bool = True,
         jsonl_path: Optional[str] = None,
     ):
+        """``enabled=False`` (the ``--no_wandb`` flag) disables only the wandb
+        sink — console + JSONL logging stay on, matching the reference where
+        ``--no_wandb`` keeps tqdm/print output (``lance_iterable.py:106,146``).
+        All sinks are process-0-gated."""
         self.is_main = jax.process_index() == 0
-        self.enabled = enabled and self.is_main
+        self.enabled = self.is_main
         self._wandb = None
         self._jsonl = None
-        if not self.enabled:
+        if not self.is_main:
             return
-        try:
-            import wandb  # type: ignore
+        if enabled:
+            try:
+                import wandb  # type: ignore
 
-            self._wandb = wandb
-            wandb.init(project=project, config=config or {}, name=run_name)
-        except Exception:
-            self._wandb = None
+                self._wandb = wandb
+                wandb.init(project=project, config=config or {}, name=run_name)
+            except Exception:
+                self._wandb = None
         path = jsonl_path or os.environ.get("LDT_METRICS_PATH", "metrics.jsonl")
         try:
             self._jsonl = open(path, "a")

@@ -1,0 +1,79 @@
+"""Tracing / profiling — the subsystem the reference lacks (SURVEY.md §5).
+
+The reference's only instrumentation is coarse epoch wall-clock timers
+(``/root/reference/lance_iterable.py:105,118``) and tqdm it/s. Here:
+
+* :func:`trace` — context manager around ``jax.profiler`` producing a
+  TensorBoard-loadable XPlane trace of device + host activity,
+* :class:`StepProfile` — lightweight per-step host-side phase timing
+  (loader / H2D / device step) that powers the loader-stall%% BASELINE
+  metric without the full profiler overhead,
+* ``annotate`` — ``TraceAnnotation`` passthrough for marking pipeline phases
+  inside traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Iterator, Optional
+
+import jax
+
+__all__ = ["trace", "annotate", "StepProfile"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/ldt-trace") -> Iterator[None]:
+    """Capture a jax.profiler trace (host + TPU) for the enclosed block."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region visible in profiler traces (host timeline)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepProfile:
+    """Accumulates per-phase host timings; reports a breakdown dict.
+
+    Usage::
+
+        with prof.phase("loader"):  batch = next(it)
+        with prof.phase("step"):    state, loss = step(state, batch)
+        prof.summary()  # {"loader_s": ..., "step_s": ..., "loader_pct": ...}
+    """
+
+    def __init__(self):
+        self.totals: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def summary(self) -> dict:
+        total = sum(self.totals.values())
+        out: dict = {}
+        for name, secs in sorted(self.totals.items()):
+            out[f"{name}_s"] = secs
+            out[f"{name}_pct"] = 100.0 * secs / total if total else 0.0
+            out[f"{name}_mean_ms"] = (
+                1000.0 * secs / self.counts[name] if self.counts[name] else 0.0
+            )
+        out["total_s"] = total
+        return out
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()

@@ -1,0 +1,81 @@
+"""Ring attention: exactness vs dense attention on a ('data','seq') mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from lance_distributed_training_tpu.models.transformer import (
+    TransformerEncoder,
+    dot_product_attention,
+)
+from lance_distributed_training_tpu.parallel.ring_attention import (
+    make_ring_attention,
+)
+
+
+def _mesh(data=2, seq=4):
+    devs = np.array(jax.devices()[: data * seq]).reshape(data, seq)
+    return Mesh(devs, ("data", "seq"))
+
+
+def _qkv(b=4, h=2, s=32, d=8, seed=0):
+    gen = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(gen.standard_normal((b, h, s, d)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def test_ring_matches_dense_no_mask():
+    mesh = _mesh()
+    q, k, v = _qkv()
+    ring = make_ring_attention(mesh)
+    dense = dot_product_attention(q, k, v, dtype=jnp.float32)
+    out = ring(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_matches_dense_with_padding_mask():
+    mesh = _mesh()
+    q, k, v = _qkv(seed=1)
+    # Last 10 key positions invalid.
+    key_valid = jnp.arange(32) < 22
+    mask = key_valid[None, None, None, :]
+    dense = dot_product_attention(
+        q, k, v, mask=jnp.broadcast_to(mask, (4, 1, 1, 32)), dtype=jnp.float32
+    )
+    ring = make_ring_attention(mesh)
+    out = ring(q, k, v, mask=jnp.broadcast_to(mask, (4, 1, 1, 32)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_output_sharded_and_jittable():
+    mesh = _mesh()
+    q, k, v = _qkv(seed=2)
+    spec = NamedSharding(mesh, P("data", None, "seq", None))
+    q = jax.device_put(q, spec)
+    k = jax.device_put(k, spec)
+    v = jax.device_put(v, spec)
+    ring = make_ring_attention(mesh)
+    out = jax.jit(lambda a, b, c: ring(a, b, c))(q, k, v)
+    assert out.sharding.spec == P("data", None, "seq", None)
+
+
+def test_transformer_with_ring_attention_end_to_end():
+    # Sequence-parallel encoder: same logits as the dense encoder.
+    mesh = _mesh(data=2, seq=4)
+    ring = make_ring_attention(mesh)
+    kwargs = dict(vocab_size=50, hidden_size=16, num_layers=2, num_heads=2,
+                  mlp_dim=32, max_len=16, dtype=jnp.float32)
+    dense_model = TransformerEncoder(**kwargs)
+    ring_model = TransformerEncoder(**kwargs, attention_fn=ring)
+    gen = np.random.default_rng(3)
+    ids = jnp.asarray(gen.integers(0, 50, (4, 16)), jnp.int32)
+    amask = jnp.asarray(np.repeat([[1] * 12 + [0] * 4], 4, 0), jnp.int8)
+    variables = dense_model.init(jax.random.key(0), ids, amask, train=False)
+    out_dense = dense_model.apply(variables, ids, amask, train=False)
+    out_ring = ring_model.apply(variables, ids, amask, train=False)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_dense),
+                               rtol=5e-3, atol=5e-3)

@@ -1,13 +1,11 @@
 """Trainer tests on the simulated 8-device CPU mesh (SURVEY.md §4)."""
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from lance_distributed_training_tpu.models import get_model_and_loss, resnet18
+from lance_distributed_training_tpu.models import get_model_and_loss, get_task
 from lance_distributed_training_tpu.ops.image import normalize_images
 from lance_distributed_training_tpu.parallel import (
     get_mesh,
@@ -41,6 +39,17 @@ def small_config(path, **kw) -> TrainConfig:
     return TrainConfig(**defaults)
 
 
+def _image_batch(mesh, n=16, size=32, classes=10, seed=0):
+    gen = np.random.default_rng(seed)
+    return make_global_batch(
+        {
+            "image": (gen.random((n, size, size, 3)) * 255).astype(np.uint8),
+            "label": gen.integers(0, classes, n).astype(np.int32),
+        },
+        mesh,
+    )
+
+
 def test_registry_parity():
     model, loss_fn, correct_fn = get_model_and_loss("classification", 101)
     assert model.num_classes == 101
@@ -58,7 +67,7 @@ def test_loss_and_correct_fns():
     assert correct_fn(logits, batch).tolist() == [1.0, 0.0]
 
 
-def test_normalize_images_fuses_math():
+def test_normalize_images_values():
     u8 = jnp.full((2, 4, 4, 3), 128, jnp.uint8)
     out = normalize_images(u8, dtype=jnp.float32)
     expect = (128 / 255 - 0.485) / 0.229
@@ -68,17 +77,13 @@ def test_normalize_images_fuses_math():
 
 def test_train_step_runs_sharded_and_reduces_loss():
     mesh = get_mesh()
-    model, loss_fn, _ = get_model_and_loss("classification", 10, "resnet18")
+    task = get_task("classification", num_classes=10, model_name="resnet18",
+                    image_size=32, augment=False)
     cfg = TrainConfig(dataset_path="", num_classes=10, lr=0.05)
-    rng = jax.random.key(0)
-    state = create_train_state(rng, model, cfg, (1, 32, 32, 3))
+    state = create_train_state(jax.random.key(0), task, cfg)
     state = jax.device_put(state, replicated_sharding(mesh))
-    step = make_train_step(loss_fn, mesh, augment=False)
-
-    gen = np.random.default_rng(0)
-    images = (gen.random((16, 32, 32, 3)) * 255).astype(np.uint8)
-    labels = gen.integers(0, 10, 16).astype(np.int32)
-    batch = make_global_batch({"image": images, "label": labels}, mesh)
+    step = make_train_step(task, mesh)
+    batch = _image_batch(mesh)
 
     losses = []
     for i in range(8):
@@ -86,27 +91,69 @@ def test_train_step_runs_sharded_and_reduces_loss():
         losses.append(float(loss))
     # Overfitting one fixed batch must reduce the loss.
     assert losses[-1] < losses[0]
-    # State stayed replicated (the DDP invariant: replicas in lockstep).
     assert int(state.step) == 8
 
 
 def test_eval_step_counts_correct():
     mesh = get_mesh()
-    model, loss_fn, correct_fn = get_model_and_loss("classification", 10, "resnet18")
+    task = get_task("classification", num_classes=10, model_name="resnet18",
+                    image_size=32)
     cfg = TrainConfig(dataset_path="", num_classes=10)
-    state = create_train_state(jax.random.key(0), model, cfg, (1, 32, 32, 3))
+    state = create_train_state(jax.random.key(0), task, cfg)
     state = jax.device_put(state, replicated_sharding(mesh))
-    eval_step = make_eval_step(correct_fn, mesh)
+    eval_step = make_eval_step(task, mesh)
+    correct = float(eval_step(state, _image_batch(mesh, n=8)))
+    assert 0 <= correct <= 8
+
+
+def test_masked_lm_task_step():
+    mesh = get_mesh()
+    task = get_task("masked_lm", model_name="bert_small", seq_len=16,
+                    vocab_size=100)
+    cfg = TrainConfig(dataset_path="", lr=0.05, seq_len=16, vocab_size=100)
+    state = create_train_state(jax.random.key(0), task, cfg)
+    state = jax.device_put(state, replicated_sharding(mesh))
+    step = make_train_step(task, mesh)
     gen = np.random.default_rng(0)
     batch = make_global_batch(
         {
-            "image": (gen.random((8, 32, 32, 3)) * 255).astype(np.uint8),
-            "label": gen.integers(0, 10, 8).astype(np.int32),
+            "input_ids": gen.integers(2, 100, (16, 16)).astype(np.int32),
+            "attention_mask": np.ones((16, 16), np.int8),
         },
         mesh,
     )
-    correct = float(eval_step(state, batch))
-    assert 0 <= correct <= 8
+    losses = []
+    for i in range(4):
+        state, loss = step(state, batch, jax.random.key(i))
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_contrastive_task_step():
+    mesh = get_mesh()
+    task = get_task("contrastive", model_name="clip_tiny", image_size=32,
+                    seq_len=8)
+    cfg = TrainConfig(dataset_path="", lr=0.05)
+    state = create_train_state(jax.random.key(0), task, cfg)
+    state = jax.device_put(state, replicated_sharding(mesh))
+    step = make_train_step(task, mesh)
+    gen = np.random.default_rng(0)
+    batch = make_global_batch(
+        {
+            "image": (gen.random((16, 32, 32, 3)) * 255).astype(np.uint8),
+            "input_ids": gen.integers(0, 1000, (16, 8)).astype(np.int32),
+            "attention_mask": np.ones((16, 8), np.int8),
+        },
+        mesh,
+    )
+    losses = []
+    for i in range(4):
+        state, loss = step(state, batch, jax.random.key(i))
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    # Global-batch InfoNCE on 16 pairs starts near ln(16).
+    assert abs(losses[0] - np.log(16)) < 1.5
 
 
 @pytest.mark.parametrize("loader_style,sampler", [("iterable", "batch"),
@@ -144,9 +191,24 @@ def test_train_eval_paths(image_dataset):
     assert 0.0 <= result["val_acc"] <= 1.0
 
 
-def test_train_rejects_indivisible_batch(image_dataset):
-    cfg = small_config(image_dataset.uri, batch_size=511)
-    # 8 devices, 1 process: fine at process level; sharding needs divisibility
-    # by device count — caught when the global batch can't form.
-    with pytest.raises(Exception):
+def test_train_folder_control_arm(tmp_path):
+    # The torch_version/ twin: same trainer, file-based loader.
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    root = tmp_path / "imgs"
+    for cls in ["a", "b"]:
+        (root / cls).mkdir(parents=True)
+        for i in range(20):
+            arr = (rng.random((32, 32, 3)) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(root / cls / f"{i}.jpg")
+    cfg = small_config(str(root), data_format="folder", num_classes=2,
+                      batch_size=16, epochs=1)
+    result = train(cfg)
+    assert np.isfinite(result["loss"])
+
+
+def test_train_rejects_too_small_dataset(image_dataset):
+    cfg = small_config(image_dataset.uri, batch_size=512)
+    with pytest.raises(ValueError, match="empty plan"):
         train(cfg)

@@ -1,0 +1,79 @@
+"""Checkpoint + profiling + metrics utility tests."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lance_distributed_training_tpu.models import get_task
+from lance_distributed_training_tpu.trainer import TrainConfig, create_train_state
+from lance_distributed_training_tpu.utils import MetricLogger, StepProfile, StepTimer
+from lance_distributed_training_tpu.utils.checkpoint import CheckpointManager
+
+
+def test_checkpoint_save_restore_roundtrip(tmp_path):
+    task = get_task("classification", num_classes=3, model_name="resnet18",
+                    image_size=32)
+    cfg = TrainConfig(dataset_path="", num_classes=3)
+    state = create_train_state(jax.random.key(0), task, cfg)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+    assert mgr.latest_step() is None
+    mgr.save(5, state, wait=True)
+    assert mgr.latest_step() == 5
+
+    fresh = create_train_state(jax.random.key(1), task, cfg)  # different init
+    restored = mgr.restore(fresh)
+    a = jax.tree_util.tree_leaves(state.params)[0]
+    b = jax.tree_util.tree_leaves(restored.params)[0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    mgr.close()
+
+
+def test_checkpoint_max_to_keep(tmp_path):
+    task = get_task("classification", num_classes=2, model_name="resnet18",
+                    image_size=32)
+    cfg = TrainConfig(dataset_path="", num_classes=2)
+    state = create_train_state(jax.random.key(0), task, cfg)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+    for step in (1, 2, 3):
+        mgr.save(step, state, wait=True)
+    assert mgr.latest_step() == 3
+    assert set(mgr.manager.all_steps()) == {2, 3}
+    mgr.close()
+
+
+def test_step_profile_breakdown():
+    prof = StepProfile()
+    import time
+
+    with prof.phase("loader"):
+        time.sleep(0.01)
+    with prof.phase("step"):
+        time.sleep(0.03)
+    s = prof.summary()
+    assert s["loader_s"] > 0 and s["step_s"] > s["loader_s"]
+    assert abs(s["loader_pct"] + s["step_pct"] - 100.0) < 1e-6
+
+
+def test_step_timer_stall_pct():
+    t = StepTimer()
+    import time
+
+    t.loader_start(); time.sleep(0.02); t.loader_stop()
+    t.step_start(); time.sleep(0.02); t.step_stop()
+    assert 20 < t.loader_stall_pct < 80
+    assert t.images_per_sec(10) > 0
+
+
+def test_metric_logger_jsonl_fallback(tmp_path, monkeypatch):
+    import json
+    import sys
+
+    monkeypatch.setitem(sys.modules, "wandb", None)  # force import failure
+    path = tmp_path / "m.jsonl"
+    logger = MetricLogger(enabled=True, jsonl_path=str(path))
+    logger.log({"loss": 1.5, "epoch": 0}, step=0)
+    logger.finish()
+    rec = json.loads(path.read_text().strip())
+    assert rec["loss"] == 1.5 and rec["step"] == 0
