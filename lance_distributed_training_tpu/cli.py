@@ -45,12 +45,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="default per task: resnet50 / bert_base / clip_resnet50_bert")
     p.add_argument("--image_size", type=int, default=224)
     p.add_argument("--seq_len", type=int, default=128)
-    p.add_argument("--vocab_size", type=int, default=30522)
+    p.add_argument("--vocab_size", type=int, default=None,
+                   help="token vocabulary; default = the model's own "
+                        "(bert_*: 30522, clip_tiny: 1000)")
     p.add_argument("--prefetch", type=int, default=2)
     p.add_argument("--no_augment", action="store_true")
     p.add_argument("--eval_every", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--run_name", type=str, default=None)
+    p.add_argument("--model_parallelism", type=int, default=1,
+                   help="tensor-parallel degree (the 'model' mesh axis)")
+    p.add_argument("--seq_parallelism", type=int, default=1,
+                   help="sequence/context-parallel degree (ring attention)")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize transformer blocks (long-context)")
     p.add_argument("--backend", type=str, default=None,
                    choices=["tpu", "cpu"],
                    help="force a JAX platform (the BASELINE --backend knob); "
@@ -77,6 +85,19 @@ def main(argv=None) -> dict:
                     f"--num_cpu_devices must be set before JAX initializes: {e}"
                 )
         jax.config.update("jax_platforms", "cpu")
+    elif args.backend == "tpu":
+        import jax
+
+        # Don't force a platform string (TPU plugins register under varying
+        # names) — verify the environment actually provides accelerators, so
+        # the flag can't silently run the job on CPU.
+        platform = jax.devices()[0].platform
+        if platform == "cpu":
+            raise SystemExit(
+                "--backend tpu requested but JAX only found CPU devices "
+                f"(platform={platform!r}); check JAX_PLATFORMS / the TPU "
+                "runtime"
+            )
     config = TrainConfig(
         dataset_path=args.dataset_path,
         task_type=args.task_type,
@@ -100,6 +121,9 @@ def main(argv=None) -> dict:
         eval_every=args.eval_every,
         seed=args.seed,
         run_name=args.run_name,
+        model_parallelism=args.model_parallelism,
+        seq_parallelism=args.seq_parallelism,
+        remat=args.remat,
     )
     return train(config)
 

@@ -92,13 +92,16 @@ def _classification_task(num_classes: int, model_name: str, image_size: int,
 
 
 # ---------------------------------------------------------------- masked LM
-def _masked_lm_task(vocab_size: int, model_name: str, seq_len: int,
-                    mask_prob: float = 0.15, mask_id: int = 1) -> Task:
+def _masked_lm_task(vocab_size: Optional[int], model_name: str, seq_len: int,
+                    mask_prob: float = 0.15, mask_id: int = 1,
+                    attention_fn: Optional[Callable] = None,
+                    remat: bool = False) -> Task:
     ctor = {"bert_base": bert_base, "bert_small": bert_small}.get(model_name)
     if ctor is None:
         raise ValueError(f"Invalid model name: {model_name} "
                          "(have ['bert_base', 'bert_small'])")
-    model = ctor(vocab_size=vocab_size, max_len=seq_len)
+    model = ctor(vocab_size=vocab_size or 30522, max_len=seq_len,
+                 attention_fn=attention_fn, remat=remat)
 
     def init_variables(rng):
         ids = jnp.zeros((1, seq_len), jnp.int32)
@@ -153,6 +156,8 @@ def _contrastive_task(model_name: str, image_size: int, seq_len: int,
     if ctor is None:
         raise ValueError(f"Invalid model name: {model_name} "
                          "(have ['clip_resnet50_bert', 'clip_tiny'])")
+    # vocab_size=None → the preset's own default (clip_tiny: 1000,
+    # clip_resnet50_bert: 30522); an explicit value always wins.
     kwargs = {"max_len": seq_len}
     if vocab_size is not None:
         kwargs["vocab_size"] = vocab_size
@@ -205,20 +210,25 @@ def get_task(
     model_name: Optional[str] = None,
     image_size: int = 224,
     seq_len: int = 128,
-    vocab_size: int = 30522,
+    vocab_size: Optional[int] = None,
     augment: bool = True,
+    attention_fn: Optional[Callable] = None,
+    remat: bool = False,
 ) -> Task:
+    """``vocab_size=None`` means "the model's own default" (bert_*: 30522,
+    clip_tiny: 1000, clip_resnet50_bert: 30522); explicit values always
+    apply verbatim."""
     if task_type == "classification":
         return _classification_task(
             num_classes, model_name or "resnet50", image_size, augment
         )
     if task_type == "masked_lm":
-        return _masked_lm_task(vocab_size, model_name or "bert_base", seq_len)
+        return _masked_lm_task(vocab_size, model_name or "bert_base", seq_len,
+                               attention_fn=attention_fn, remat=remat)
     if task_type == "contrastive":
         return _contrastive_task(
             model_name or "clip_resnet50_bert", image_size, seq_len,
-            vocab_size if model_name != "clip_tiny" else None,
-            augment=augment,
+            vocab_size, augment=augment,
         )
     # Error-message parity: modelling/get_model_and_loss.py:10-11.
     raise ValueError(f"Invalid task type: {task_type}")

@@ -29,12 +29,17 @@ _load_failed = False
 
 
 def _build() -> bool:
+    # Link into a temp file, then rename over _LIB_PATH: the replaced path
+    # gets a NEW inode, so a later dlopen cannot be deduplicated against a
+    # stale handle that was opened from the old file.
+    tmp = _LIB_PATH + ".tmp"
     cmd = [
         "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-        _SRC, "-o", _LIB_PATH, "-ljpeg", "-pthread",
+        _SRC, "-o", tmp, "-ljpeg", "-pthread",
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB_PATH)
         return True
     except (subprocess.SubprocessError, OSError):
         return False
@@ -61,6 +66,11 @@ def _load() -> Optional[ctypes.CDLL]:
                     _load_failed = True
                     return None
                 lib = ctypes.CDLL(_LIB_PATH)
+                if lib.ldt_decode_abi_version() != _ABI_VERSION:
+                    # Rebuilt from source yet still mismatched: the source
+                    # itself is a different ABI generation — don't bind.
+                    _load_failed = True
+                    return None
             lib.ldt_decode_batch.restype = ctypes.c_int
             lib.ldt_decode_batch.argtypes = [
                 ctypes.POINTER(ctypes.c_char_p),

@@ -101,21 +101,14 @@ int decode_one(const uint8_t* data, size_t len, int out_size, uint8_t* dst,
     uint8_t* row = scratch.data() + (size_t)cinfo.output_scanline * row_bytes;
     jpeg_read_scanlines(&cinfo, &row, 1);
   }
+  // out_color_space was forced to JCS_RGB before jpeg_start_decompress, so
+  // libjpeg itself converts grayscale/YCbCr → 3 components (unconvertible
+  // color spaces longjmp to the error path). Capture before destroy.
+  const int components = cinfo.output_components;
   jpeg_finish_decompress(&cinfo);
   jpeg_destroy_decompress(&cinfo);
+  if (components != 3) return 2;
 
-  if (cinfo.output_components != 3) {
-    // Grayscale (or odd component count): expand to RGB in place, back-to-front.
-    if (cinfo.output_components == 1) {
-      std::vector<uint8_t> rgb((size_t)sw * sh * 3);
-      for (size_t i = 0; i < (size_t)sw * sh; ++i) {
-        rgb[i * 3] = rgb[i * 3 + 1] = rgb[i * 3 + 2] = scratch[i];
-      }
-      scratch.swap(rgb);
-    } else {
-      return 2;
-    }
-  }
   if (sw == out_size && sh == out_size) {
     std::memcpy(dst, scratch.data(), (size_t)out_size * out_size * 3);
   } else {

@@ -15,3 +15,10 @@ from .mesh import (  # noqa: F401
     sync_global_devices,
 )
 from .ring_attention import make_ring_attention, ring_attention  # noqa: F401
+from .sharding import (  # noqa: F401
+    TRANSFORMER_RULES,
+    batch_partition_spec,
+    partition_specs,
+    rules_for_task,
+    state_shardings,
+)

@@ -46,20 +46,40 @@ def maybe_initialize_distributed() -> None:
 def get_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     data_axis: str = "data",
-    model_axis: Optional[str] = None,
+    model_axis: Optional[str] = "model",
     model_parallelism: int = 1,
+    seq_axis: Optional[str] = "seq",
+    seq_parallelism: int = 1,
 ) -> Mesh:
-    """Build the device mesh. Default: 1-D ``('data',)`` over all devices."""
+    """Build the device mesh.
+
+    Default is the reference-parity topology: 1-D ``('data',)`` over all
+    devices (DDP, SURVEY.md §2.3). ``model_parallelism`` adds a trailing
+    tensor-parallel axis, ``seq_parallelism`` a sequence/context-parallel axis
+    (ring attention rides it, :mod:`.ring_attention`); the data axis absorbs
+    the remaining devices. Axis order is ``(data, model, seq)`` — data
+    outermost so its collectives (gradient psum) span the slower links when a
+    multi-host mesh maps ICI-first.
+    """
     devices = list(jax.devices()) if devices is None else list(devices)
     n = len(devices)
-    if model_axis is None or model_parallelism == 1:
-        return Mesh(np.array(devices), (data_axis,))
-    if n % model_parallelism:
+    mp = model_parallelism if model_axis is not None else 1
+    sp = seq_parallelism if seq_axis is not None else 1
+    if mp < 1 or sp < 1:
+        raise ValueError(f"parallelism degrees must be >=1, got {mp=} {sp=}")
+    if n % (mp * sp):
         raise ValueError(
-            f"{n} devices not divisible by model_parallelism={model_parallelism}"
+            f"{n} devices not divisible by model_parallelism*seq_parallelism="
+            f"{mp * sp}"
         )
-    grid = np.array(devices).reshape(n // model_parallelism, model_parallelism)
-    return Mesh(grid, (data_axis, model_axis))
+    shape, axes = [n // (mp * sp)], [data_axis]
+    if mp > 1:
+        shape.append(mp)
+        axes.append(model_axis)
+    if sp > 1:
+        shape.append(sp)
+        axes.append(seq_axis)
+    return Mesh(np.array(devices).reshape(shape), tuple(axes))
 
 
 def batch_sharding(mesh: Mesh, data_axis: str = "data") -> NamedSharding:
@@ -72,7 +92,12 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def make_global_batch(pytree, mesh: Mesh, data_axis: str = "data"):
+def make_global_batch(
+    pytree,
+    mesh: Mesh,
+    data_axis: str = "data",
+    seq_axis: Optional[str] = None,
+):
     """Host numpy arrays → one *global* ``jax.Array`` batch-sharded over the mesh.
 
     The TPU-native answer to the reference's per-rank ``.to(device)`` copies
@@ -80,11 +105,18 @@ def make_global_batch(pytree, mesh: Mesh, data_axis: str = "data"):
     its local shard; JAX assembles the logical global array. Works both
     single-process (local data = global data, split across local devices) and
     multi-process (``jax.make_array_from_process_local_data``).
+
+    With ``seq_axis`` set, rank-2 leaves (token arrays ``[B, S]``) are
+    additionally split along the sequence axis — context parallelism's input
+    layout (each device holds a [batch-shard × sequence-block] tile).
     """
-    sharding = batch_sharding(mesh, data_axis)
+    from .sharding import batch_partition_spec
 
     def _put(x):
         x = np.asarray(x)
+        spec = batch_partition_spec(x.ndim, data_axis=data_axis,
+                                    seq_axis=seq_axis)
+        sharding = NamedSharding(mesh, spec)
         if jax.process_count() == 1:
             return jax.device_put(x, sharding)
         return jax.make_array_from_process_local_data(sharding, x)

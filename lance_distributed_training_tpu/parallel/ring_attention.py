@@ -103,29 +103,50 @@ def ring_attention(
 
 
 def make_ring_attention(mesh: Mesh, *, data_axis: str = "data",
-                        seq_axis: str = "seq"):
+                        seq_axis: str = "seq", model_axis: str = "model"):
     """Drop-in ``attention_fn`` for :class:`..models.transformer.SelfAttention`.
 
-    Takes GLOBAL [B, H, S, D] arrays (sharded ``P(data_axis, None,
-    seq_axis)``), runs the ring under ``shard_map``, returns the same global
-    layout. Mask must be the key-validity mask ``[B, 1, 1, S]``.
+    Takes GLOBAL [B, H, S, D] arrays, runs the ring under ``shard_map``,
+    returns the same global layout. Mask must be the key-validity mask
+    ``[B, 1, 1, S]``. When the mesh also has a tensor-parallel ``model_axis``
+    (a dp×tp×sp run with :data:`~.sharding.TRANSFORMER_RULES`), the head dim
+    is kept sharded over it — heads are independent in attention, so each
+    (model, seq) device tile rings over its own head shard and no all-gather
+    of QKV is ever needed.
     """
 
-    qkv_spec = P(data_axis, None, seq_axis, None)
-    mask_spec = P(data_axis, None, None, seq_axis)
+    def _build(head_axis):
+        qkv_spec = P(data_axis, head_axis, seq_axis, None)
+        mask_spec = P(data_axis, None, None, seq_axis)
 
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
-        out_specs=qkv_spec,
-    )
-    def _sharded(q, k, v, mask):
-        return ring_attention(q, k, v, mask, axis_name=seq_axis)
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+            out_specs=qkv_spec,
+        )
+        def _sharded(q, k, v, mask):
+            return ring_attention(q, k, v, mask, axis_name=seq_axis)
+
+        return _sharded
+
+    cache: dict = {}
 
     def attention_fn(q, k, v, mask=None, dtype=None):
+        dp = mesh.shape.get(data_axis, 1)
+        sp = mesh.shape.get(seq_axis, 1)
+        mp = mesh.shape.get(model_axis, 1)
+        if q.shape[0] % dp or q.shape[2] % sp:
+            # Shapes that don't tile the mesh (model.init's batch of 1,
+            # ragged eval remainders): exact dense fallback.
+            from ..models.transformer import dot_product_attention
+
+            return dot_product_attention(q, k, v, mask=mask, dtype=q.dtype)
+        head_axis = model_axis if (mp > 1 and q.shape[1] % mp == 0) else None
+        if head_axis not in cache:
+            cache[head_axis] = _build(head_axis)
         if mask is None:
             mask = jnp.ones((q.shape[0], 1, 1, q.shape[2]), bool)
-        return _sharded(q, k, v, mask)
+        return cache[head_axis](q, k, v, mask)
 
     return attention_fn

@@ -86,7 +86,7 @@ class TrainConfig:
     model_name: Optional[str] = None  # default per task (resnet50 / bert_base / clip)
     image_size: int = 224
     seq_len: int = 128  # masked_lm / contrastive text length
-    vocab_size: int = 30522
+    vocab_size: Optional[int] = None  # None = the model's own default
     prefetch: int = 2
     augment: bool = True
     eval_at_end: bool = True  # rank-0 eval over train loader (lance_iterable.py:125-127)
@@ -94,9 +94,27 @@ class TrainConfig:
     seed: int = 0
     run_name: Optional[str] = None
     log_every: int = 50
+    # -- parallelism beyond the reference's DP-only scope (SURVEY.md §2.3) --
+    model_parallelism: int = 1  # tensor-parallel degree ('model' mesh axis)
+    seq_parallelism: int = 1  # context-parallel degree ('seq' axis, ring attn)
+    remat: bool = False  # rematerialize transformer blocks (long-context)
 
 
-def _task_from_config(config: TrainConfig) -> Task:
+def _task_from_config(config: TrainConfig, mesh=None) -> Task:
+    attention_fn = None
+    if config.seq_parallelism > 1:
+        if config.task_type != "masked_lm":
+            raise ValueError(
+                "seq_parallelism>1 requires a sequence model (masked_lm)"
+            )
+        if config.seq_len % config.seq_parallelism:
+            raise ValueError(
+                f"seq_len {config.seq_len} not divisible by "
+                f"seq_parallelism {config.seq_parallelism}"
+            )
+        from .parallel.ring_attention import make_ring_attention
+
+        attention_fn = make_ring_attention(mesh)
     return get_task(
         config.task_type,
         num_classes=config.num_classes,
@@ -105,6 +123,8 @@ def _task_from_config(config: TrainConfig) -> Task:
         seq_len=config.seq_len,
         vocab_size=config.vocab_size,
         augment=config.augment,
+        attention_fn=attention_fn,
+        remat=config.remat,
     )
 
 
@@ -119,6 +139,37 @@ def create_train_state(rng: jax.Array, task: Task, config: TrainConfig) -> Train
     )
 
 
+def create_sharded_train_state(
+    rng: jax.Array, task: Task, config: TrainConfig, mesh, rules=()
+):
+    """Initialize the TrainState *directly sharded* over the mesh.
+
+    Init runs under jit with ``out_shardings`` derived from the partition
+    rules, so each device materialises only its parameter shard — no host
+    round-trip, no full replica anywhere (how a model larger than one chip's
+    HBM gets initialized). Returns ``(state, sharding_pytree)``.
+    """
+    from .parallel.sharding import state_shardings
+
+    # One tx instance shared by the eval_shape pass and the jitted init —
+    # TrainState's static metadata (tx, apply_fn) must be identical in the
+    # out_shardings prefix tree and the actual output.
+    tx = optax.sgd(config.lr, momentum=config.momentum)
+
+    def _create(r):
+        variables = task.init_variables(r)
+        return TrainState.create(
+            apply_fn=None,
+            params=variables["params"],
+            batch_stats=variables.get("batch_stats"),
+            tx=tx,
+        )
+
+    abstract = jax.eval_shape(_create, rng)
+    shardings = state_shardings(abstract, mesh, rules)
+    return jax.jit(_create, out_shardings=shardings)(rng), shardings
+
+
 def _variables(state: TrainState) -> dict:
     v = {"params": state.params}
     if state.batch_stats is not None:
@@ -126,14 +177,22 @@ def _variables(state: TrainState) -> dict:
     return v
 
 
-def make_train_step(task: Task, mesh, *, donate: bool = True):
-    """Build the jitted DP train step.
+def make_train_step(task: Task, mesh, *, donate: bool = True,
+                    state_sharding=None, batch_spec=None):
+    """Build the jitted sharded train step.
 
-    State is replicated (``P()``), every batch leaf sharded ``P('data')`` on
-    its leading dim; under those in-shardings XLA turns the per-shard
-    gradients into a mean via an all-reduce over ICI — the compiled
-    equivalent of DDP's bucketed NCCL all-reduce
+    Pure DP (the reference's scope): state replicated (``P()``), every batch
+    leaf sharded ``P('data')`` on its leading dim; under those in-shardings
+    XLA turns the per-shard gradients into a mean via an all-reduce over ICI —
+    the compiled equivalent of DDP's bucketed NCCL all-reduce
     (``/root/reference/lance_iterable.py:93-97``; ``README.md:185``).
+
+    Beyond DP: pass ``state_sharding`` (a NamedSharding pytree from
+    :func:`~.parallel.sharding.state_shardings`) to tensor-parallel-shard
+    params + optimizer state over the ``'model'`` axis, and ``batch_spec``
+    (e.g. ``P('data', 'seq')``) to lay token batches out for context
+    parallelism. The SPMD partitioner derives every collective from these
+    annotations — no communication code here.
     """
 
     def step(state: TrainState, batch, rng):
@@ -151,24 +210,36 @@ def make_train_step(task: Task, mesh, *, donate: bool = True):
         return state, loss
 
     repl = replicated_sharding(mesh)
-    data = batch_sharding(mesh)
+    state_sh = state_sharding if state_sharding is not None else repl
+    if batch_spec is not None:
+        from jax.sharding import NamedSharding
+
+        data = NamedSharding(mesh, batch_spec)
+    else:
+        data = batch_sharding(mesh)
     return jax.jit(
         step,
-        in_shardings=(repl, data, repl),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sh, data, repl),
+        out_shardings=(state_sh, repl),
         donate_argnums=(0,) if donate else (),
     )
 
 
-def make_eval_step(task: Task, mesh):
+def make_eval_step(task: Task, mesh, *, state_sharding=None, batch_spec=None):
     repl = replicated_sharding(mesh)
-    data = batch_sharding(mesh)
+    state_sh = state_sharding if state_sharding is not None else repl
+    if batch_spec is not None:
+        from jax.sharding import NamedSharding
+
+        data = NamedSharding(mesh, batch_spec)
+    else:
+        data = batch_sharding(mesh)
 
     def step(state: TrainState, batch):
         outputs, _ = task.forward(_variables(state), batch, False, None)
         return task.metric(outputs, batch).sum()
 
-    return jax.jit(step, in_shardings=(repl, data), out_shardings=repl)
+    return jax.jit(step, in_shardings=(state_sh, data), out_shardings=repl)
 
 
 def evaluate(state, loader, eval_step) -> float:
@@ -204,7 +275,11 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0):
             f"{process_count} processes"
         )
     decode = _decoder_for(config)
-    put = partial(make_global_batch, mesh=mesh)
+    put = partial(
+        make_global_batch,
+        mesh=mesh,
+        seq_axis="seq" if config.seq_parallelism > 1 else None,
+    )
     if config.data_format == "folder":
         # Control arm: plain files, no columnar store (torch_version/ twin,
         # reference README.md:286-290).
@@ -223,6 +298,15 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0):
         )
         if len(loader) == 0:
             raise ValueError("folder smaller than one global batch")
+        if (
+            config.task_type == "classification"
+            and loader.num_classes > config.num_classes
+        ):
+            raise ValueError(
+                f"folder has {loader.num_classes} class directories but "
+                f"num_classes={config.num_classes}; out-of-range labels "
+                "would be silently clamped by the XLA gather"
+            )
         return loader
     if config.loader_style == "map":
         loader = MapStylePipeline(
@@ -261,20 +345,37 @@ def train(config: TrainConfig) -> dict:
     devices = jax.devices()
     if config.no_ddp:
         devices = devices[:1]
-    mesh = get_mesh(devices)
+    mesh = get_mesh(
+        devices,
+        model_parallelism=config.model_parallelism,
+        seq_parallelism=config.seq_parallelism,
+    )
 
     dataset = (
         Dataset(config.dataset_path) if config.data_format == "columnar" else None
     )
-    task = _task_from_config(config)
+    task = _task_from_config(config, mesh)
 
     rng = jax.random.key(config.seed)
     rng, init_rng = jax.random.split(rng)
-    state = create_train_state(init_rng, task, config)
-    state = jax.device_put(state, replicated_sharding(mesh))
+    from .parallel.sharding import batch_partition_spec, rules_for_task
 
-    train_step = make_train_step(task, mesh)
-    eval_step = make_eval_step(task, mesh)
+    rules = rules_for_task(task.name) if config.model_parallelism > 1 else ()
+    state, state_sharding = create_sharded_train_state(
+        init_rng, task, config, mesh, rules
+    )
+    batch_spec = (
+        batch_partition_spec(2, seq_axis="seq")
+        if config.seq_parallelism > 1
+        else None
+    )
+
+    train_step = make_train_step(
+        task, mesh, state_sharding=state_sharding, batch_spec=batch_spec
+    )
+    eval_step = make_eval_step(
+        task, mesh, state_sharding=state_sharding, batch_spec=batch_spec
+    )
 
     n_devices = len(mesh.devices.flatten())
     logger = MetricLogger(
