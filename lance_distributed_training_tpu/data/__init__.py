@@ -28,3 +28,4 @@ from .authoring import (  # noqa: F401
     create_text_token_dataset,
 )
 from .folder import FolderDataPipeline  # noqa: F401
+from .workers import WorkerPool, columnar_spec, folder_spec  # noqa: F401

@@ -66,8 +66,11 @@ class ImageClassificationDecoder:
         self.image_column = image_column
         self.label_column = label_column
         self.use_native = use_native
+        self._bind_native()
+
+    def _bind_native(self) -> None:
         self._native = None
-        if use_native:
+        if self.use_native:
             try:
                 from ..native import batch_decode_jpeg, native_available
 
@@ -75,6 +78,17 @@ class ImageClassificationDecoder:
                     self._native = batch_decode_jpeg
             except Exception:
                 self._native = None
+
+    # Picklable for process-pool workers (the ctypes binding can't cross the
+    # process boundary; each worker re-binds its own).
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_native"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._bind_native()
 
     def _decode_one(self, payload: bytes) -> np.ndarray:
         from PIL import Image

@@ -45,6 +45,7 @@ class FolderDataPipeline:
         epoch: int = 0,
         drop_last: bool = True,
         prefetch: int = 2,
+        workers=None,
     ):
         self.samples, self.classes = _folder_samples(root)
         if not self.samples:
@@ -59,6 +60,7 @@ class FolderDataPipeline:
         self.epoch = epoch
         self.drop_last = drop_last
         self.prefetch = prefetch
+        self.workers = workers
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -106,5 +108,6 @@ class FolderDataPipeline:
             device_put_fn=self.device_put_fn,
             prefetch=self.prefetch,
             read_fn=lambda _ds, idx: self._read(idx),
+            workers=self.workers,
         )
         return iter(pipe)

@@ -65,6 +65,10 @@ class DataPipeline:
     device_put_fn: host batch dict → device batch (a closure over
         ``make_global_batch(mesh)``); ``None`` yields host numpy batches.
     prefetch: queue depth of decoded batches kept ahead of the consumer.
+    workers: optional :class:`~.workers.WorkerPool` — read+decode runs in N
+        worker processes instead of the producer thread (the reference's
+        ``get_safe_loader``/``num_workers`` path,
+        ``/root/reference/lance_map_style.py:60-69``).
     """
 
     def __init__(
@@ -75,6 +79,7 @@ class DataPipeline:
         device_put_fn: Optional[Callable[[dict], dict]] = None,
         prefetch: int = 2,
         read_fn: Callable[[Dataset, object], pa.Table] = _range_read,
+        workers=None,
     ):
         self.dataset = dataset
         self.plan = list(plan)
@@ -82,16 +87,23 @@ class DataPipeline:
         self.device_put_fn = device_put_fn
         self.prefetch = max(1, prefetch)
         self.read_fn = read_fn
+        self.workers = workers
 
     def __len__(self) -> int:
         return len(self.plan)
 
     def _produce(self, q: "queue.Queue", stop: threading.Event) -> None:
         try:
-            for item in self.plan:
-                if stop.is_set():
-                    return
-                q.put(self.decode_fn(self.read_fn(self.dataset, item)))
+            if self.workers is not None:
+                for out in self.workers.imap(self.plan):
+                    if stop.is_set():
+                        return
+                    q.put(out)
+            else:
+                for item in self.plan:
+                    if stop.is_set():
+                        return
+                    q.put(self.decode_fn(self.read_fn(self.dataset, item)))
             q.put(_SENTINEL)
         except BaseException as exc:  # surface worker errors to the consumer
             q.put(exc)
@@ -135,6 +147,7 @@ def make_train_pipeline(
     device_put_fn: Optional[Callable] = None,
     prefetch: int = 2,
     check_deadlock: bool = True,
+    workers=None,
 ) -> DataPipeline:
     """Iterable-style pipeline — parity with ``get_sampler``+``get_dataset``+
     ``get_loader`` (``/root/reference/lance_iterable.py:53-72,86-88``).
@@ -155,7 +168,8 @@ def make_train_pipeline(
         plan: Plan = plans[process_index]
     else:
         plan = make_plan(sampler_type, rows, batch_size, process_index, process_count)
-    return DataPipeline(dataset, plan, decode_fn, device_put_fn, prefetch)
+    return DataPipeline(dataset, plan, decode_fn, device_put_fn, prefetch,
+                        workers=workers)
 
 
 class MapStylePipeline:
@@ -181,6 +195,7 @@ class MapStylePipeline:
         epoch: int = 0,
         drop_last: bool = True,
         prefetch: int = 2,
+        workers=None,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -193,6 +208,7 @@ class MapStylePipeline:
         self.epoch = epoch
         self.drop_last = drop_last
         self.prefetch = prefetch
+        self.workers = workers
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -221,6 +237,7 @@ class MapStylePipeline:
                 self.device_put_fn,
                 self.prefetch,
                 read_fn=_take_read,
+                workers=self.workers,
             )
         )
 

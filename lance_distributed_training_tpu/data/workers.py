@@ -1,0 +1,144 @@
+"""Process-pool decode workers — the ``get_safe_loader`` equivalent.
+
+The reference's map-style path gets decode parallelism from torch DataLoader
+worker *processes* running ``collate_fn``
+(``/root/reference/lance_map_style.py:60-69``, ``num_workers=8``, spawn
+context + ``persistent_workers`` at ``torch_version/map_style.py:63-74``),
+via upstream's ``get_safe_loader`` — "Safe" because each worker must re-open
+the native dataset handle rather than inherit it across ``fork``
+(``README.md:24,60``; SURVEY.md §7 "fork-safe w.r.t. the native reader
+handle").
+
+Here the same capability is a :class:`WorkerPool`: N spawned processes, each
+re-opening the columnar store by URI in its initializer (our ``Dataset``
+handles are just memory-maps — cheap to re-open, nothing to inherit), running
+read+decode for whole plan items and streaming results back **in plan order**
+with a bounded in-flight window. The training process never touches a JPEG.
+
+When to use which decode parallelism:
+
+* ``num_workers=0`` (default): producer thread + native C++ decoder
+  (:mod:`..native`) — the decode pool releases the GIL, so threads already
+  scale across cores with zero IPC cost. Best when the native path is built.
+* ``num_workers>0``: process workers — true parallelism for *Python-bound*
+  decode hooks (custom ``to_tensor_fn``/``collate_fn`` plugins that hold the
+  GIL), at the cost of pickling each decoded batch across the IPC boundary.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+from collections import deque
+from concurrent.futures import ProcessPoolExecutor
+from typing import Callable, Iterable, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+__all__ = ["WorkerPool", "columnar_spec", "folder_spec"]
+
+# Per-worker state, set by the pool initializer (module-global because
+# ProcessPoolExecutor task functions must be importable module-level names).
+_STATE: Optional[tuple] = None
+
+
+def columnar_spec(uri: str) -> Tuple[str, object]:
+    """Reader spec for a columnar dataset: workers re-open by URI."""
+    return ("columnar", str(uri))
+
+
+def folder_spec(samples: Sequence[Tuple[str, int]]) -> Tuple[str, object]:
+    """Reader spec for the folder control arm: (path, label) samples."""
+    return ("folder", list(samples))
+
+
+def _init_worker(reader_spec, decode_fn) -> None:
+    global _STATE
+    kind, payload = reader_spec
+    if kind == "columnar":
+        from .format import Dataset
+
+        reader = Dataset(payload)
+    elif kind == "folder":
+        reader = payload
+    else:
+        raise ValueError(f"unknown reader spec kind {kind!r}")
+    _STATE = (kind, reader, decode_fn)
+
+
+def _read_item(kind: str, reader, item) -> pa.Table:
+    if kind == "folder":
+        payloads, labels = [], []
+        for i in np.asarray(item):
+            path, label = reader[int(i)]
+            with open(path, "rb") as f:
+                payloads.append(f.read())
+            labels.append(label)
+        return pa.table(
+            {"image": pa.array(payloads, pa.binary()),
+             "label": pa.array(labels, pa.int64())}
+        )
+    if isinstance(item, np.ndarray):  # map-style: global-index take
+        return reader.take(item)
+    # iterable-style: list of ReadRange
+    tables = [reader.read_range(r.fragment, r.start, r.stop) for r in item]
+    return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+
+
+def _run_item(item):
+    assert _STATE is not None, "worker not initialized"
+    kind, reader, decode_fn = _STATE
+    return decode_fn(_read_item(kind, reader, item))
+
+
+class WorkerPool:
+    """Persistent spawn-context process pool running read+decode.
+
+    ``persistent_workers=True`` parity: create once, reuse across epochs
+    (``/root/reference/lance_map_style.py:68``); workers keep their dataset
+    handle and decoder warm between epochs.
+    """
+
+    def __init__(
+        self,
+        reader_spec: Tuple[str, object],
+        decode_fn: Callable,
+        num_workers: int,
+    ):
+        if num_workers < 1:
+            raise ValueError("WorkerPool needs num_workers >= 1")
+        self.num_workers = num_workers
+        # Spawn, not fork: fork would inherit locks/ctypes handles mid-state —
+        # the exact hazard upstream's SafeLanceDataset exists to avoid.
+        self._pool = ProcessPoolExecutor(
+            max_workers=num_workers,
+            mp_context=mp.get_context("spawn"),
+            initializer=_init_worker,
+            initargs=(reader_spec, decode_fn),
+        )
+
+    def imap(self, items: Iterable, window: int = 0) -> Iterator[dict]:
+        """Ordered streaming map: results yielded in submission order, at most
+        ``window`` items in flight (default: 2× workers)."""
+        window = window or 2 * self.num_workers
+        it = iter(items)
+        pending: deque = deque()
+        try:
+            for item in it:
+                pending.append(self._pool.submit(_run_item, item))
+                if len(pending) >= window:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
+        finally:
+            for fut in pending:
+                fut.cancel()
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def __enter__(self) -> "WorkerPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -80,7 +80,7 @@ class TrainConfig:
     epochs: int = 10
     lr: float = 0.05
     momentum: float = 0.9
-    num_workers: int = 0  # decode threads are pooled; kept for CLI parity
+    num_workers: int = 0  # >0: decode in N worker processes (get_safe_loader parity)
     no_ddp: bool = False  # single-device escape hatch (lance_iterable.py:145)
     no_wandb: bool = False  # lance_iterable.py:146
     model_name: Optional[str] = None  # default per task (resnet50 / bert_base / clip)
@@ -266,7 +266,28 @@ def _decoder_for(config: TrainConfig):
     raise ValueError(f"Invalid task type: {config.task_type}")
 
 
-def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0):
+def _make_worker_pool(config: TrainConfig, dataset):
+    """Persistent decode-worker pool (``num_workers``/``persistent_workers``
+    parity, ``/root/reference/lance_map_style.py:60-69``). None when
+    ``num_workers == 0`` — decode then runs on the producer thread + the
+    native decoder's own thread pool."""
+    if config.num_workers <= 0:
+        return None
+    from .data.workers import WorkerPool, columnar_spec, folder_spec
+
+    decode = _decoder_for(config)
+    if config.data_format == "folder":
+        from .data.authoring import _folder_samples
+
+        samples, _ = _folder_samples(config.dataset_path)
+        return WorkerPool(folder_spec(samples), decode, config.num_workers)
+    return WorkerPool(
+        columnar_spec(config.dataset_path), decode, config.num_workers
+    )
+
+
+def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
+                  workers=None):
     process_index, process_count = process_topology()
     per_process = config.batch_size // process_count
     if per_process * process_count != config.batch_size:
@@ -295,6 +316,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0):
             seed=config.seed,
             epoch=epoch,
             prefetch=config.prefetch,
+            workers=workers,
         )
         if len(loader) == 0:
             raise ValueError("folder smaller than one global batch")
@@ -319,6 +341,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0):
             seed=config.seed,
             epoch=epoch,
             prefetch=config.prefetch,
+            workers=workers,
         )
     else:
         loader = make_train_pipeline(
@@ -330,6 +353,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0):
             decode,
             put,
             prefetch=config.prefetch,
+            workers=workers,
         )
     if len(loader) == 0:
         raise ValueError(
@@ -390,8 +414,9 @@ def train(config: TrainConfig) -> dict:
     total_start = time.perf_counter()
     global_step = 0
 
+    worker_pool = _make_worker_pool(config, dataset)
     for epoch in range(config.epochs):
-        loader = _build_loader(config, dataset, mesh, epoch)
+        loader = _build_loader(config, dataset, mesh, epoch, worker_pool)
         timer.reset()
         epoch_start = time.perf_counter()
         loss_sum = jnp.zeros((), jnp.float32)  # stays on device all epoch
@@ -423,7 +448,8 @@ def train(config: TrainConfig) -> dict:
             "loader_stall_pct": timer.loader_stall_pct,
         }
         if config.eval_every and (epoch + 1) % config.eval_every == 0:
-            val_loader = _build_loader(config, dataset, mesh, epoch)
+            val_loader = _build_loader(config, dataset, mesh, epoch,
+                                       worker_pool)
             epoch_metrics["val_acc"] = evaluate(state, val_loader, eval_step)
         logger.log(epoch_metrics, step=epoch)
         results = epoch_metrics
@@ -433,8 +459,10 @@ def train(config: TrainConfig) -> dict:
         # Final eval over the train loader, as the reference does
         # (lance_iterable.py:125-127) — here all processes participate since
         # eval is itself a sharded computation.
-        loader = _build_loader(config, dataset, mesh, 0)
+        loader = _build_loader(config, dataset, mesh, 0, worker_pool)
         results["train_acc"] = evaluate(state, loader, eval_step)
         logger.log({"train_acc": results["train_acc"]})
+    if worker_pool is not None:
+        worker_pool.shutdown()
     logger.finish()
     return results
