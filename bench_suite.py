@@ -1,0 +1,274 @@
+"""Benchmark suite — one entry per BASELINE.json config.
+
+The driver's headline metric stays in ``bench.py`` (FOOD101 ResNet-50
+iterable, images/sec/chip). This suite covers all five BASELINE configs end
+to end through the REAL product path — ``train()`` with its per-epoch
+{images_per_sec_per_chip, loader_stall_pct} metrics — not a stripped-down
+loop, so the numbers include everything a user would hit:
+
+1. ``food101-resnet18-map``   FOOD101-shaped, map-style, single-process CPU
+                              (parity: lance_map_style.py on CPU)
+2. ``food101-resnet50-iter``  FOOD101-shaped, iterable + sharded-batch plan
+                              on the available accelerator (bench.py's twin)
+3. ``imagenet-fragment``      ImageNet-shaped (1000 classes), fragment-
+                              sharded scan (ShardedFragmentSampler parity)
+4. ``c4-bert``                packed token columns → masked-LM BERT
+5. ``laion-clip``             mixed-modal image+caption → CLIP contrastive
+
+Usage::
+
+    python bench_suite.py                # all five, one JSON line each
+    python bench_suite.py c4-bert        # just one
+    BENCH_SMALL=1 python bench_suite.py  # tiny shapes (CI / smoke)
+
+Each config runs in a subprocess so backend choice (config 1 is CPU by
+definition) and compile caches are isolated. Epoch 0 absorbs compile; the
+reported numbers are epoch 1's steady state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+SMALL = bool(os.environ.get("BENCH_SMALL"))
+
+REFERENCE_IMAGES_PER_SEC_PER_CHIP = 87.7  # /root/reference/README.md:164-184
+
+CONFIG_NAMES = [
+    "food101-resnet18-map",
+    "food101-resnet50-iter",
+    "imagenet-fragment",
+    "c4-bert",
+    "laion-clip",
+]
+
+
+def _force_cpu(n_devices: int = 1) -> None:
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except RuntimeError:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _on_accelerator() -> bool:
+    import jax
+
+    return jax.devices()[0].platform != "cpu"
+
+
+def _train_metrics(cfg, steps_hint: int) -> dict:
+    """Run train() for 2 epochs; epoch 1 (post-compile) is the measurement."""
+    from lance_distributed_training_tpu.trainer import train
+
+    results = train(cfg)
+    return {
+        "images_per_sec_per_chip": results.get("images_per_sec_per_chip", 0.0),
+        "loader_stall_pct": results.get("loader_stall_pct", 0.0),
+        "loss": results.get("loss"),
+        "steps_per_epoch": steps_hint,
+    }
+
+
+def run_config(name: str) -> dict:
+    from lance_distributed_training_tpu.trainer import TrainConfig
+
+    # BENCH_BACKEND=cpu pins the whole suite to CPU (smoke runs, or a box
+    # whose TPU tunnel is busy); BENCH_CPU_DEVICES simulates a mesh.
+    if os.environ.get("BENCH_BACKEND") == "cpu":
+        _force_cpu(int(os.environ.get("BENCH_CPU_DEVICES") or 1))
+
+    tmp = tempfile.mkdtemp(prefix=f"ldt-suite-{name}-")
+    uri = os.path.join(tmp, "ds")
+    common = dict(no_wandb=True, eval_at_end=False, epochs=2, prefetch=3)
+
+    if name == "food101-resnet18-map":
+        # "FOOD101 ResNet-18 map-style (single-process CPU)" — CPU by
+        # definition, one device (the reference's --no_ddp smoke config).
+        _force_cpu(1)
+        from lance_distributed_training_tpu.data import (
+            create_synthetic_classification_dataset,
+        )
+
+        batch, steps = (16, 3) if SMALL else (64, 6)
+        size = 96 if SMALL else 224
+        rows = batch * steps
+        create_synthetic_classification_dataset(
+            uri, rows, num_classes=101, image_size=size,
+            fragment_size=max(rows // 4, 1),
+        )
+        cfg = TrainConfig(
+            dataset_path=uri, num_classes=101, model_name="resnet18",
+            image_size=size, batch_size=batch, loader_style="map",
+            no_ddp=True, **common,
+        )
+        m = _train_metrics(cfg, steps)
+        unit, value = "images/sec/chip", m["images_per_sec_per_chip"]
+        vs = None
+
+    elif name == "food101-resnet50-iter":
+        # bench.py's headline twin: iterable loader + sharded-batch plan.
+        from lance_distributed_training_tpu.data import (
+            create_synthetic_classification_dataset,
+        )
+        import jax
+
+        accel = _on_accelerator()
+        model = "resnet50" if accel else "resnet18"
+        per_chip = 16 if SMALL else (128 if accel else 32)
+        batch = per_chip * len(jax.devices())
+        steps = 3 if SMALL else 8
+        size = 96 if SMALL else 224
+        rows = batch * steps
+        create_synthetic_classification_dataset(
+            uri, rows, num_classes=101, image_size=size,
+            fragment_size=max(rows // 4, 1),
+        )
+        cfg = TrainConfig(
+            dataset_path=uri, num_classes=101, model_name=model,
+            image_size=size, batch_size=batch, sampler_type="batch",
+            loader_style="iterable", **common,
+        )
+        m = _train_metrics(cfg, steps)
+        unit, value = "images/sec/chip", m["images_per_sec_per_chip"]
+        vs = (
+            round(value / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3)
+            if accel and model == "resnet50"
+            else None
+        )
+
+    elif name == "imagenet-fragment":
+        # ImageNet-shaped: 1000 classes, whole-fragment sequential reads
+        # (ShardedFragmentSampler parity, reference README.md:128).
+        from lance_distributed_training_tpu.data import (
+            create_synthetic_classification_dataset,
+        )
+        import jax
+
+        accel = _on_accelerator()
+        model = "resnet50" if accel else "resnet18"
+        per_chip = 16 if SMALL else (128 if accel else 32)
+        batch = per_chip * len(jax.devices())
+        steps = 3 if SMALL else 8
+        size = 96 if SMALL else 224
+        rows = batch * steps
+        create_synthetic_classification_dataset(
+            uri, rows, num_classes=1000, image_size=size,
+            fragment_size=max(rows // 8, 1),
+        )
+        cfg = TrainConfig(
+            dataset_path=uri, num_classes=1000, model_name=model,
+            image_size=size, batch_size=batch, sampler_type="fragment",
+            loader_style="iterable", **common,
+        )
+        m = _train_metrics(cfg, steps)
+        unit, value = "images/sec/chip", m["images_per_sec_per_chip"]
+        vs = None
+
+    elif name == "c4-bert":
+        # Packed token columns → masked-LM BERT (C4 config). bert_base on an
+        # accelerator; bert_small on CPU so the suite stays runnable.
+        import numpy as np
+
+        from lance_distributed_training_tpu.data import (
+            create_text_token_dataset,
+        )
+        import jax
+
+        accel = _on_accelerator()
+        model = "bert_base" if accel else "bert_small"
+        vocab = 30522 if accel else 2048
+        seq_len = 32 if SMALL else 128
+        per_chip = 8 if SMALL else (64 if accel else 16)
+        batch = per_chip * len(jax.devices())
+        steps = 3 if SMALL else 8
+        rows = batch * steps
+        gen = np.random.default_rng(0)
+        docs = [
+            gen.integers(2, vocab, gen.integers(seq_len // 2, seq_len * 2))
+            .tolist()
+            for _ in range(rows)
+        ]
+        create_text_token_dataset(uri, docs, seq_len=seq_len,
+                                  fragment_size=max(rows // 4, 1))
+        cfg = TrainConfig(
+            dataset_path=uri, task_type="masked_lm", model_name=model,
+            vocab_size=vocab, seq_len=seq_len, batch_size=batch, **common,
+        )
+        m = _train_metrics(cfg, steps)
+        unit = "tokens/sec/chip"
+        value = m["images_per_sec_per_chip"] * seq_len
+        vs = None
+
+    elif name == "laion-clip":
+        # Mixed-modal image+caption → CLIP contrastive collate.
+        from lance_distributed_training_tpu.data import (
+            create_synthetic_image_text_dataset,
+        )
+        import jax
+
+        accel = _on_accelerator()
+        model = "clip_resnet50_bert" if accel else "clip_tiny"
+        seq_len = 16
+        size = 224 if accel and not SMALL else 64
+        per_chip = 8 if SMALL else (64 if accel else 16)
+        batch = per_chip * len(jax.devices())
+        steps = 3 if SMALL else 6
+        rows = batch * steps
+        create_synthetic_image_text_dataset(
+            uri, rows, seq_len=seq_len, image_size=size,
+            fragment_size=max(rows // 4, 1),
+        )
+        cfg = TrainConfig(
+            dataset_path=uri, task_type="contrastive", model_name=model,
+            image_size=size, seq_len=seq_len, batch_size=batch, **common,
+        )
+        m = _train_metrics(cfg, steps)
+        unit, value = "pairs/sec/chip", m["images_per_sec_per_chip"]
+        vs = None
+
+    else:
+        raise SystemExit(f"unknown config {name!r} (have {CONFIG_NAMES})")
+
+    return {
+        "metric": name,
+        "value": round(float(value), 2),
+        "unit": unit,
+        "vs_baseline": vs,
+        "loader_stall_pct": round(float(m["loader_stall_pct"]), 2),
+        "loss": round(float(m["loss"]), 4) if m["loss"] is not None else None,
+    }
+
+
+def main() -> None:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    if "--run" in sys.argv:
+        # Child mode: run one config in THIS process, print its JSON line.
+        name = sys.argv[sys.argv.index("--run") + 1]
+        print(json.dumps(run_config(name)), flush=True)
+        return
+    names = args or CONFIG_NAMES
+    for name in names:
+        if name not in CONFIG_NAMES:
+            raise SystemExit(f"unknown config {name!r} (have {CONFIG_NAMES})")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--run", name],
+            capture_output=True, text=True,
+        )
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+        if proc.returncode != 0 or not lines:
+            print(json.dumps({"metric": name, "error":
+                              (proc.stderr or "no output").strip()[-400:]}),
+                  flush=True)
+            continue
+        print(lines[-1], flush=True)
+
+
+if __name__ == "__main__":
+    main()

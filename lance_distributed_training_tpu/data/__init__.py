@@ -25,6 +25,7 @@ from .pipeline import (  # noqa: F401
 from .authoring import (  # noqa: F401
     create_dataset_from_image_folder,
     create_synthetic_classification_dataset,
+    create_synthetic_image_text_dataset,
     create_text_token_dataset,
 )
 from .folder import FolderDataPipeline  # noqa: F401

@@ -23,6 +23,7 @@ from .format import Dataset, write_dataset
 __all__ = [
     "create_dataset_from_image_folder",
     "create_synthetic_classification_dataset",
+    "create_synthetic_image_text_dataset",
     "create_text_token_dataset",
     "IMAGE_SCHEMA",
 ]
@@ -146,6 +147,69 @@ def create_synthetic_classification_dataset(
 
     return write_dataset(
         gen(), output_path, schema=IMAGE_SCHEMA, mode="overwrite",
+        max_rows_per_file=fragment_size,
+    )
+
+
+def create_synthetic_image_text_dataset(
+    output_path: str,
+    rows: int,
+    seq_len: int = 16,
+    vocab_size: int = 1000,
+    image_size: int = 224,
+    fragment_size: int = 12500,
+    unique_images: int = 64,
+    seed: int = 0,
+    jpeg_quality: int = 85,
+) -> Dataset:
+    """LAION-shaped mixed-modal dataset: {image: JPEG binary, input_ids,
+    attention_mask} — the CLIP contrastive BASELINE config ("LAION-subset
+    image+caption → CLIP (mixed-modal collate)"). Captions are pre-tokenised
+    fixed-size-list columns, images JPEG bytes; the decode hook is
+    :class:`..decode.ImageTextDecoder`."""
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    pool = []
+    for _ in range(min(unique_images, rows)):
+        arr = (rng.random((image_size, image_size, 3)) * 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=jpeg_quality)
+        pool.append(buf.getvalue())
+    schema = pa.schema(
+        [
+            ("image", pa.binary()),
+            ("input_ids", pa.list_(pa.int32(), seq_len)),
+            ("attention_mask", pa.list_(pa.int8(), seq_len)),
+        ]
+    )
+
+    def gen() -> Iterator[pa.RecordBatch]:
+        done = 0
+        while done < rows:
+            n = min(4096, rows - done)
+            images = [pool[(done + i) % len(pool)] for i in range(n)]
+            lengths = rng.integers(seq_len // 2, seq_len + 1, n)
+            ids = [
+                list(rng.integers(2, vocab_size, length))
+                + [0] * (seq_len - length)
+                for length in lengths
+            ]
+            mask = [
+                [1] * length + [0] * (seq_len - length) for length in lengths
+            ]
+            yield pa.record_batch(
+                [
+                    pa.array(images, pa.binary()),
+                    pa.array(ids, schema.field("input_ids").type),
+                    pa.array(mask, schema.field("attention_mask").type),
+                ],
+                schema=schema,
+            )
+            done += n
+
+    return write_dataset(
+        gen(), output_path, schema=schema, mode="overwrite",
         max_rows_per_file=fragment_size,
     )
 
