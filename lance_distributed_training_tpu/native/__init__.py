@@ -6,4 +6,8 @@ pool (:mod:`.jpeg`), built lazily with g++ on first use and falling back to
 the pure-Python PIL path when unavailable.
 """
 
-from .jpeg import batch_decode_jpeg, native_available  # noqa: F401
+from .jpeg import (  # noqa: F401
+    batch_decode_jpeg,
+    batch_decode_jpeg_arrow,
+    native_available,
+)

@@ -16,12 +16,12 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-__all__ = ["batch_decode_jpeg", "native_available"]
+__all__ = ["batch_decode_jpeg", "batch_decode_jpeg_arrow", "native_available"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "ldt_decode.cpp")
 _LIB_PATH = os.path.join(_HERE, "_ldt_decode.so")
-_ABI_VERSION = 1
+_ABI_VERSION = 2
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -81,6 +81,16 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_uint8),
                 ctypes.c_int,
             ]
+            lib.ldt_decode_batch_offsets.restype = ctypes.c_int
+            lib.ldt_decode_batch_offsets.argtypes = [
+                ctypes.c_void_p,  # values buffer
+                ctypes.POINTER(ctypes.c_int64),  # offsets[n+1]
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int,
+            ]
             _lib = lib
         except OSError:
             _load_failed = True
@@ -117,6 +127,53 @@ def batch_decode_jpeg(
     lib.ldt_decode_batch(
         ctypes.cast(srcs, ctypes.POINTER(ctypes.c_char_p)),
         ctypes.cast(lens, ctypes.POINTER(ctypes.c_size_t)),
+        n,
+        out_size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        failed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n_threads,
+    )
+    return out, failed
+
+
+def batch_decode_jpeg_arrow(
+    binary_array,
+    out_size: int,
+    n_threads: int = 0,
+    out: Optional[np.ndarray] = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Decode an Arrow binary/large_binary array of JPEGs, zero-copy.
+
+    Reads straight from the column's Arrow buffers (values + offsets) — no
+    per-row Python ``bytes`` are materialised, unlike
+    ``to_pylist()``-then-:func:`batch_decode_jpeg`. ``binary_array`` must be
+    a non-chunked ``pyarrow.Array``; rows must be non-null.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native decoder unavailable")
+    n = len(binary_array)
+    if out is None:
+        out = np.empty((n, out_size, out_size, 3), dtype=np.uint8)
+    if n == 0:
+        return out, np.zeros(0, np.uint8)
+    import pyarrow as pa
+
+    buffers = binary_array.buffers()  # [validity, offsets, values]
+    if buffers[0] is not None and binary_array.null_count:
+        raise ValueError("null image rows are not decodable")
+    width = 8 if pa.types.is_large_binary(binary_array.type) else 4
+    raw = np.frombuffer(
+        buffers[1], dtype=np.int64 if width == 8 else np.int32,
+        count=binary_array.offset + n + 1,
+    )
+    offsets = np.ascontiguousarray(
+        raw[binary_array.offset : binary_array.offset + n + 1], dtype=np.int64
+    )
+    failed = np.zeros(n, dtype=np.uint8)
+    lib.ldt_decode_batch_offsets(
+        ctypes.c_void_p(buffers[2].address),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         n,
         out_size,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),

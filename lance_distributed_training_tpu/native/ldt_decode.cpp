@@ -157,7 +157,48 @@ int ldt_decode_batch(const uint8_t** srcs, const size_t* lens, int n,
   return failures.load();
 }
 
+// Zero-copy Arrow path: decode n JPEGs described by an Arrow binary column's
+// buffers — `data` is the values buffer, `offsets[i]..offsets[i+1]` delimits
+// image i (int64, as in Arrow large_binary; the Python side widens int32
+// offsets). No per-row Python bytes objects are ever materialised.
+int ldt_decode_batch_offsets(const uint8_t* data, const int64_t* offsets,
+                             int n, int out_size, uint8_t* out,
+                             uint8_t* failed, int n_threads) {
+  if (n <= 0) return 0;
+  const size_t img_bytes = (size_t)out_size * out_size * 3;
+  if (n_threads <= 0) n_threads = (int)std::thread::hardware_concurrency();
+  if (n_threads > n) n_threads = n;
+  std::atomic<int> next(0), failures(0);
+  auto worker = [&]() {
+    std::vector<uint8_t> scratch;
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
+      uint8_t* dst = out + (size_t)i * img_bytes;
+      const int64_t lo = offsets[i], hi = offsets[i + 1];
+      int rc = (hi > lo)
+                   ? decode_one(data + lo, (size_t)(hi - lo), out_size, dst,
+                                scratch)
+                   : 1;
+      if (rc != 0) {
+        std::memset(dst, 0, img_bytes);
+        if (failed) failed[i] = 1;
+        failures.fetch_add(1);
+      } else if (failed) {
+        failed[i] = 0;
+      }
+    }
+  };
+  if (n_threads <= 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(n_threads);
+    for (int t = 0; t < n_threads; ++t) pool.emplace_back(worker);
+    for (auto& th : pool) th.join();
+  }
+  return failures.load();
+}
+
 // Version tag so the Python side can detect stale builds.
-int ldt_decode_abi_version() { return 1; }
+int ldt_decode_abi_version() { return 2; }
 
 }  // extern "C"

@@ -90,3 +90,75 @@ def test_decoder_class_uses_native_with_pil_fallback(image_table):
     # non-fancy chroma upsampling vs PIL's ISLOW/fancy); smooth images agree
     # within ~3 (test_decode_matches_pil_closely).
     assert diff < 20.0
+
+
+@pytest.fixture(scope="module")
+def jpeg_payloads():
+    rng = np.random.default_rng(11)
+    return [_jpeg((rng.random((48, 48, 3)) * 255).astype(np.uint8))
+            for _ in range(8)]
+
+
+def test_arrow_path_matches_pylist_path(jpeg_payloads):
+    """Zero-copy Arrow-buffer decode must be bit-identical to the c_char_p
+    path, including on sliced (non-zero offset) arrays."""
+    import pyarrow as pa
+
+    from lance_distributed_training_tpu.native import (
+        batch_decode_jpeg,
+        batch_decode_jpeg_arrow,
+        native_available,
+    )
+
+    if not native_available():
+        pytest.skip("native decoder not built")
+    arr = pa.array(jpeg_payloads, pa.binary())
+    via_list, f1 = batch_decode_jpeg(jpeg_payloads, 32)
+    via_arrow, f2 = batch_decode_jpeg_arrow(arr, 32)
+    assert not f1.any() and not f2.any()
+    np.testing.assert_array_equal(via_list, via_arrow)
+    # Sliced array: offsets no longer start at 0.
+    sliced = arr.slice(1, len(jpeg_payloads) - 2)
+    via_sliced, f3 = batch_decode_jpeg_arrow(sliced, 32)
+    assert not f3.any()
+    np.testing.assert_array_equal(via_sliced, via_list[1:-1])
+    # large_binary offsets (int64) work too.
+    large = arr.cast(pa.large_binary())
+    via_large, f4 = batch_decode_jpeg_arrow(large, 32)
+    np.testing.assert_array_equal(via_large, via_list)
+
+
+def test_arrow_path_flags_corrupt_rows(jpeg_payloads):
+    import pyarrow as pa
+
+    from lance_distributed_training_tpu.native import (
+        batch_decode_jpeg_arrow,
+        native_available,
+    )
+
+    if not native_available():
+        pytest.skip("native decoder not built")
+    payloads = list(jpeg_payloads[:3]) + [b"not a jpeg"] + list(jpeg_payloads[3:])
+    arr = pa.array(payloads, pa.binary())
+    images, failed = batch_decode_jpeg_arrow(arr, 32)
+    assert failed.tolist() == [0, 0, 0, 1] + [0] * (len(payloads) - 4)
+    assert not images[3].any()  # zero-filled failed slot
+
+
+def test_decoder_uses_arrow_path(jpeg_payloads):
+    """ImageClassificationDecoder over a Table equals the raw native output."""
+    import pyarrow as pa
+
+    from lance_distributed_training_tpu.data.decode import (
+        ImageClassificationDecoder,
+    )
+
+    table = pa.table(
+        {"image": pa.array(jpeg_payloads, pa.binary()),
+         "label": pa.array(range(len(jpeg_payloads)), pa.int64())}
+    )
+    dec = ImageClassificationDecoder(image_size=32)
+    out = dec(table)
+    ref = dec.decode_payloads(list(jpeg_payloads))
+    np.testing.assert_array_equal(out["image"], ref)
+    assert out["label"].tolist() == list(range(len(jpeg_payloads)))
