@@ -59,6 +59,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequence/context-parallel degree (ring attention)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize transformer blocks (long-context)")
+    p.add_argument("--checkpoint_dir", type=str, default=None,
+                   help="orbax checkpoint root; resumes from the latest "
+                        "checkpoint when one exists")
+    p.add_argument("--checkpoint_every", type=int, default=1,
+                   help="save every N epochs")
+    p.add_argument("--no_resume", action="store_true",
+                   help="ignore existing checkpoints, start fresh")
+    p.add_argument("--profile_dir", type=str, default=None,
+                   help="capture a jax.profiler trace of early steps")
     p.add_argument("--backend", type=str, default=None,
                    choices=["tpu", "cpu"],
                    help="force a JAX platform (the BASELINE --backend knob); "
@@ -124,6 +133,10 @@ def main(argv=None) -> dict:
         model_parallelism=args.model_parallelism,
         seq_parallelism=args.seq_parallelism,
         remat=args.remat,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        resume=not args.no_resume,
+        profile_dir=args.profile_dir,
     )
     return train(config)
 

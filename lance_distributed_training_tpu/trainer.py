@@ -98,6 +98,11 @@ class TrainConfig:
     model_parallelism: int = 1  # tensor-parallel degree ('model' mesh axis)
     seq_parallelism: int = 1  # context-parallel degree ('seq' axis, ring attn)
     remat: bool = False  # rematerialize transformer blocks (long-context)
+    # -- aux subsystems the reference lacks (SURVEY.md §5) --
+    checkpoint_dir: Optional[str] = None  # orbax save/restore root
+    checkpoint_every: int = 1  # save every N epochs
+    resume: bool = True  # restore the latest checkpoint if one exists
+    profile_dir: Optional[str] = None  # jax.profiler trace of early steps
 
 
 def _task_from_config(config: TrainConfig, mesh=None) -> Task:
@@ -414,19 +419,54 @@ def train(config: TrainConfig) -> dict:
     total_start = time.perf_counter()
     global_step = 0
 
+    # Checkpoint/resume — preemption recovery the reference delegates to its
+    # launcher with nothing to restore (SURVEY.md §5). The saved step index is
+    # "epochs completed"; resume re-enters the epoch loop there.
+    ckpt = None
+    start_epoch = 0
+    if config.checkpoint_dir:
+        from .utils.checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(config.checkpoint_dir)
+        if config.resume:
+            latest = ckpt.latest_step()
+            if latest is not None:
+                state = ckpt.restore(state)
+                start_epoch = min(latest, config.epochs)
+                # The per-step rng stream continues from the resume point; it
+                # differs from an uninterrupted run (masking/augment draws),
+                # which is fine — only the fold order changes, not the data.
+                rng = jax.random.fold_in(rng, start_epoch)
+
+    profiling = False
+
     worker_pool = _make_worker_pool(config, dataset)
-    for epoch in range(config.epochs):
+    for epoch in range(start_epoch, config.epochs):
         loader = _build_loader(config, dataset, mesh, epoch, worker_pool)
         timer.reset()
         epoch_start = time.perf_counter()
         loss_sum = jnp.zeros((), jnp.float32)  # stays on device all epoch
         it = iter(loader)
+        epoch_step = 0
         while True:
             timer.loader_start()
             batch = next(it, None)
             timer.loader_stop()
             if batch is None:
                 break
+            if (
+                config.profile_dir
+                and epoch == start_epoch
+                and jax.process_index() == 0
+            ):
+                # Trace a post-compile window of the first epoch: steps
+                # [2, 12). Step 0/1 are compile+warmup noise.
+                if epoch_step == 2 and not profiling:
+                    jax.profiler.start_trace(config.profile_dir)
+                    profiling = True
+                elif epoch_step == 12 and profiling:
+                    jax.profiler.stop_trace()
+                    profiling = False
             rng, step_rng = jax.random.split(rng)
             timer.step_start()
             state, loss = train_step(state, batch, step_rng)
@@ -435,6 +475,10 @@ def train(config: TrainConfig) -> dict:
                 jax.block_until_ready(loss)  # bound async queue depth
             timer.step_stop()
             global_step += 1
+            epoch_step += 1
+        if profiling:  # epoch shorter than the trace window
+            jax.profiler.stop_trace()
+            profiling = False
         jax.block_until_ready(loss_sum)
         epoch_time = time.perf_counter() - epoch_start
         steps = timer.steps
@@ -453,8 +497,11 @@ def train(config: TrainConfig) -> dict:
             epoch_metrics["val_acc"] = evaluate(state, val_loader, eval_step)
         logger.log(epoch_metrics, step=epoch)
         results = epoch_metrics
+        if ckpt is not None and (epoch + 1) % config.checkpoint_every == 0:
+            ckpt.save(epoch + 1, state)
 
     results["total_time"] = time.perf_counter() - total_start
+    results["start_epoch"] = start_epoch
     if config.eval_at_end:
         # Final eval over the train loader, as the reference does
         # (lance_iterable.py:125-127) — here all processes participate since
@@ -464,5 +511,7 @@ def train(config: TrainConfig) -> dict:
         logger.log({"train_acc": results["train_acc"]})
     if worker_pool is not None:
         worker_pool.shutdown()
+    if ckpt is not None:
+        ckpt.close()
     logger.finish()
     return results

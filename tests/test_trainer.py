@@ -212,3 +212,39 @@ def test_train_rejects_too_small_dataset(image_dataset):
     cfg = small_config(image_dataset.uri, batch_size=512)
     with pytest.raises(ValueError, match="empty plan"):
         train(cfg)
+
+
+def test_checkpoint_resume(tmp_path, image_dataset):
+    """Train 2 epochs with checkpointing; a rerun asking for 3 epochs resumes
+    from epoch 2 and runs exactly one more."""
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    base = dict(
+        dataset_path=image_dataset.uri, num_classes=10, model_name="resnet18",
+        image_size=32, batch_size=16, no_wandb=True, eval_at_end=False,
+        checkpoint_dir=ckpt_dir,
+    )
+    r1 = train(TrainConfig(epochs=2, **base))
+    assert r1["epoch"] == 1 and r1["start_epoch"] == 0
+
+    r2 = train(TrainConfig(epochs=3, **base))
+    assert r2["start_epoch"] == 2  # resumed, not retrained
+    assert r2["epoch"] == 2
+    assert np.isfinite(r2["loss"])
+
+
+def test_profile_trace_written(tmp_path, image_dataset):
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    prof_dir = str(tmp_path / "trace")
+    train(TrainConfig(
+        dataset_path=image_dataset.uri, num_classes=10, model_name="resnet18",
+        image_size=32, batch_size=16, epochs=1, no_wandb=True,
+        eval_at_end=False, profile_dir=prof_dir,
+    ))
+    import glob
+
+    assert glob.glob(prof_dir + "/**/*.xplane.pb", recursive=True), (
+        "no xplane trace written"
+    )
