@@ -21,6 +21,8 @@ from .trainer import TrainConfig, train
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="TPU-native distributed training")
     p.add_argument("--dataset_path", type=str, required=True)
+    p.add_argument("--val_dataset_path", type=str, default=None,
+                   help="held-out split for evaluation (default: train loader)")
     p.add_argument("--task_type", type=str, default="classification",
                    choices=["classification", "masked_lm", "contrastive"])
     p.add_argument("--num_classes", type=int, default=101)
@@ -59,6 +61,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequence/context-parallel degree (ring attention)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize transformer blocks (long-context)")
+    p.add_argument("--flash_attention", action="store_true",
+                   help="Pallas fused attention kernel (TPU; exact dense "
+                        "fallback elsewhere)")
     p.add_argument("--checkpoint_dir", type=str, default=None,
                    help="orbax checkpoint root; resumes from the latest "
                         "checkpoint when one exists")
@@ -109,6 +114,7 @@ def main(argv=None) -> dict:
             )
     config = TrainConfig(
         dataset_path=args.dataset_path,
+        val_dataset_path=args.val_dataset_path,
         task_type=args.task_type,
         num_classes=args.num_classes,
         sampler_type=args.sampler_type,
@@ -133,6 +139,7 @@ def main(argv=None) -> dict:
         model_parallelism=args.model_parallelism,
         seq_parallelism=args.seq_parallelism,
         remat=args.remat,
+        flash_attention=args.flash_attention,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         resume=not args.no_resume,

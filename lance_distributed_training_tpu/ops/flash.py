@@ -1,0 +1,86 @@
+"""Pallas flash attention — fused TPU attention for the transformer tasks.
+
+The reference has no attention at all (vision-only); this framework's text
+arm defaults to XLA einsum attention (:func:`..models.transformer.
+dot_product_attention`), which materialises the [B, H, S, S] score matrix in
+HBM. For long sequences the fused Pallas kernel
+(``jax.experimental.pallas.ops.tpu.flash_attention``, forward + backward)
+keeps scores in VMEM tiles instead — O(S) HBM traffic, the standard
+flash-attention memory profile — and runs on the MXU via Mosaic.
+
+``make_flash_attention()`` returns a drop-in ``attention_fn`` for
+:class:`..models.transformer.SelfAttention`:
+
+* on TPU: the Pallas kernel; the key-validity mask is lowered to segment ids
+  (valid tokens form segment 1, padding segment 0, so valid queries never
+  attend padding; padding queries attend only padding, and their outputs are
+  dead — the MLM loss masks them),
+* elsewhere (CPU tests, simulated meshes): exact dense fallback.
+
+Composition note: this is the *single-device* attention path. For sequence
+parallelism use :mod:`..parallel.ring_attention` instead — the two are
+alternative ``attention_fn`` values, selected by the trainer
+(``--flash_attention`` vs ``--seq_parallelism``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["make_flash_attention", "flash_available"]
+
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+def flash_available() -> bool:
+    try:
+        from jax.experimental.pallas.ops.tpu import flash_attention  # noqa: F401
+    except Exception:
+        return False
+    return jax.default_backend() in _TPU_PLATFORMS
+
+
+def make_flash_attention(block_q: int = 512, block_k: int = 512):
+    """Build an ``attention_fn(q, k, v, mask=None, dtype=None)``.
+
+    q/k/v are [B, H, S, D]; mask (optional) is the key-validity mask
+    [B, 1, 1, S] produced by :class:`..models.transformer.TransformerEncoder`.
+    """
+    use_pallas = flash_available()
+    if use_pallas:
+        from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+    def attention_fn(q, k, v, mask=None, dtype=None):
+        if not use_pallas:
+            from ..models.transformer import dot_product_attention
+
+            return dot_product_attention(q, k, v, mask=mask, dtype=q.dtype)
+        scale = 1.0 / float(q.shape[-1]) ** 0.5
+        seq = q.shape[2]
+        sizes = fa.BlockSizes(
+            block_q=min(block_q, seq),
+            block_k_major=min(block_k, seq),
+            block_k=min(block_k, seq),
+            block_b=1,
+            block_q_major_dkv=min(block_q, seq),
+            block_k_major_dkv=min(block_k, seq),
+            block_k_dkv=min(block_k, seq),
+            block_q_dkv=min(block_q, seq),
+            block_k_major_dq=min(block_k, seq),
+            block_k_dq=min(block_k, seq),
+            block_q_dq=min(block_q, seq),
+        )
+        segment_ids = None
+        if mask is not None:
+            valid = mask.reshape(mask.shape[0], mask.shape[-1]).astype(jnp.int32)
+            segment_ids = fa.SegmentIds(q=valid, kv=valid)
+        out = fa.flash_attention(
+            q, k, v, segment_ids=segment_ids, sm_scale=scale,
+            block_sizes=sizes,
+        )
+        return out.astype(q.dtype)
+
+    return attention_fn

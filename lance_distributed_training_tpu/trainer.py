@@ -71,6 +71,9 @@ class TrainConfig:
     (``/root/reference/lance_iterable.py:136-146``) plus TPU/task knobs."""
 
     dataset_path: str
+    val_dataset_path: Optional[str] = None  # held-out split for eval_every /
+    # eval_at_end (the reference's Food101 split='test' val loader,
+    # torch_version/map_style.py:57); default: eval over the train loader
     task_type: str = "classification"
     num_classes: int = 101
     sampler_type: str = "batch"  # batch | fragment | full (lance_iterable.py:61-69)
@@ -98,6 +101,7 @@ class TrainConfig:
     model_parallelism: int = 1  # tensor-parallel degree ('model' mesh axis)
     seq_parallelism: int = 1  # context-parallel degree ('seq' axis, ring attn)
     remat: bool = False  # rematerialize transformer blocks (long-context)
+    flash_attention: bool = False  # Pallas fused attention (TPU; dense elsewhere)
     # -- aux subsystems the reference lacks (SURVEY.md §5) --
     checkpoint_dir: Optional[str] = None  # orbax save/restore root
     checkpoint_every: int = 1  # save every N epochs
@@ -120,6 +124,12 @@ def _task_from_config(config: TrainConfig, mesh=None) -> Task:
         from .parallel.ring_attention import make_ring_attention
 
         attention_fn = make_ring_attention(mesh)
+    elif config.flash_attention:
+        if config.task_type != "masked_lm":
+            raise ValueError("flash_attention requires a sequence model")
+        from .ops.flash import make_flash_attention
+
+        attention_fn = make_flash_attention()
     return get_task(
         config.task_type,
         num_classes=config.num_classes,
@@ -383,6 +393,11 @@ def train(config: TrainConfig) -> dict:
     dataset = (
         Dataset(config.dataset_path) if config.data_format == "columnar" else None
     )
+    val_dataset = (
+        Dataset(config.val_dataset_path)
+        if config.val_dataset_path and config.data_format == "columnar"
+        else None
+    )
     task = _task_from_config(config, mesh)
 
     rng = jax.random.key(config.seed)
@@ -492,8 +507,15 @@ def train(config: TrainConfig) -> dict:
             "loader_stall_pct": timer.loader_stall_pct,
         }
         if config.eval_every and (epoch + 1) % config.eval_every == 0:
-            val_loader = _build_loader(config, dataset, mesh, epoch,
-                                       worker_pool)
+            # Worker pools are bound to the TRAIN dataset URI; a held-out
+            # split must not reuse them.
+            val_loader = _build_loader(
+                config,
+                val_dataset if val_dataset is not None else dataset,
+                mesh,
+                epoch,
+                worker_pool if val_dataset is None else None,
+            )
             epoch_metrics["val_acc"] = evaluate(state, val_loader, eval_step)
         logger.log(epoch_metrics, step=epoch)
         results = epoch_metrics
@@ -503,12 +525,19 @@ def train(config: TrainConfig) -> dict:
     results["total_time"] = time.perf_counter() - total_start
     results["start_epoch"] = start_epoch
     if config.eval_at_end:
-        # Final eval over the train loader, as the reference does
-        # (lance_iterable.py:125-127) — here all processes participate since
-        # eval is itself a sharded computation.
-        loader = _build_loader(config, dataset, mesh, 0, worker_pool)
-        results["train_acc"] = evaluate(state, loader, eval_step)
-        logger.log({"train_acc": results["train_acc"]})
+        # Final eval — over the val split when given, else over the train
+        # loader as the reference does (lance_iterable.py:125-127); all
+        # processes participate since eval is itself a sharded computation.
+        key = "val_acc" if val_dataset is not None else "train_acc"
+        loader = _build_loader(
+            config,
+            val_dataset if val_dataset is not None else dataset,
+            mesh,
+            0,
+            worker_pool if val_dataset is None else None,
+        )
+        results[key] = evaluate(state, loader, eval_step)
+        logger.log({key: results[key]})
     if worker_pool is not None:
         worker_pool.shutdown()
     if ckpt is not None:

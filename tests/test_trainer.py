@@ -248,3 +248,38 @@ def test_profile_trace_written(tmp_path, image_dataset):
     assert glob.glob(prof_dir + "/**/*.xplane.pb", recursive=True), (
         "no xplane trace written"
     )
+
+
+def test_val_dataset_path(tmp_path, image_dataset, image_table):
+    """A held-out split drives eval_every/eval_at_end instead of the train
+    loader (reference torch_version/map_style.py:57 val split)."""
+    from lance_distributed_training_tpu.data import write_dataset
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    val = write_dataset(image_table.slice(0, 64), tmp_path / "val",
+                        mode="create", max_rows_per_file=32)
+    results = train(TrainConfig(
+        dataset_path=image_dataset.uri, val_dataset_path=val.uri,
+        num_classes=10, model_name="resnet18", image_size=32, batch_size=16,
+        epochs=1, no_wandb=True, eval_every=1,
+    ))
+    assert "val_acc" in results and 0.0 <= results["val_acc"] <= 1.0
+
+
+def test_flash_attention_flag_cpu_fallback(tmp_path):
+    """--flash_attention on CPU uses the exact dense fallback; training runs."""
+    import numpy as np
+
+    from lance_distributed_training_tpu.data import create_text_token_dataset
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    gen = np.random.default_rng(0)
+    docs = [gen.integers(2, 256, 40).tolist() for _ in range(100)]
+    uri = str(tmp_path / "tok")
+    create_text_token_dataset(uri, docs, seq_len=32, fragment_size=64)
+    results = train(TrainConfig(
+        dataset_path=uri, task_type="masked_lm", model_name="bert_small",
+        vocab_size=256, seq_len=32, batch_size=16, epochs=1, no_wandb=True,
+        eval_at_end=False, flash_attention=True,
+    ))
+    assert np.isfinite(results["loss"])
