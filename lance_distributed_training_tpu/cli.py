@@ -61,6 +61,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequence/context-parallel degree (ring attention)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize transformer blocks (long-context)")
+    p.add_argument("--num_experts", type=int, default=0,
+                   help=">0: switch-MoE transformer blocks; experts shard "
+                        "over the 'model' mesh axis (expert parallelism)")
+    p.add_argument("--moe_every", type=int, default=2,
+                   help="MoE MLP on every Nth block")
     p.add_argument("--flash_attention", action="store_true",
                    help="Pallas fused attention kernel (TPU; exact dense "
                         "fallback elsewhere)")
@@ -140,6 +145,8 @@ def main(argv=None) -> dict:
         seq_parallelism=args.seq_parallelism,
         remat=args.remat,
         flash_attention=args.flash_attention,
+        num_experts=args.num_experts,
+        moe_every=args.moe_every,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         resume=not args.no_resume,

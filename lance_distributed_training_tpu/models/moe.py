@@ -1,0 +1,104 @@
+"""Mixture-of-Experts MLP — expert parallelism for the transformer arm.
+
+Beyond the reference's scope (DP-only, SURVEY.md §2.3), built the TPU way:
+top-1 switch routing expressed entirely as einsums over a dense dispatch
+tensor — no scatter/gather, no data-dependent shapes, so XLA tiles everything
+onto the MXU and the SPMD partitioner shards the expert dimension over the
+mesh's ``'model'`` axis (see ``MOE_RULES`` in :mod:`..parallel.sharding`):
+each device group holds ``num_experts / tp`` experts and the dispatch einsum
+becomes the expert all-to-all.
+
+Routing follows the Switch Transformer recipe: top-1 expert per token, fixed
+per-expert capacity ``ceil(capacity_factor * tokens / num_experts)`` (static
+shape!), overflow tokens pass through the residual unchanged, and a
+load-balance auxiliary loss (fraction-routed × mean-probability per expert)
+is exposed via ``sow`` for the task loss to pick up.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["MoEMLP"]
+
+
+class MoEMLP(nn.Module):
+    """Switch-routed expert MLP: ``[B, S, H] -> [B, S, H]``.
+
+    Capacity note: tokens beyond an expert's queue contribute zero to the
+    output (their dispatch weight is masked), which with the transformer's
+    residual connection means they simply skip the MLP — the standard
+    overflow behavior.
+    """
+
+    num_experts: int
+    mlp_dim: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, h = x.shape
+        t = b * s
+        e = self.num_experts
+        capacity = max(1, int(self.capacity_factor * t / e))
+        tokens = x.reshape(t, h)
+
+        # Router in f32 for a stable softmax.
+        logits = nn.Dense(e, dtype=jnp.float32, param_dtype=jnp.float32,
+                          name="router")(tokens.astype(jnp.float32))
+        probs = nn.softmax(logits, axis=-1)  # [T, E]
+        expert_index = jnp.argmax(probs, axis=-1)  # [T]
+        expert_prob = jnp.max(probs, axis=-1)  # gate value of the winner
+
+        onehot = jax.nn.one_hot(expert_index, e, dtype=jnp.float32)  # [T, E]
+        # Position of each token in its expert's queue (1-based), then mask
+        # out tokens past capacity — all static shapes.
+        position = jnp.cumsum(onehot, axis=0) * onehot  # [T, E]
+        within = (position > 0) & (position <= capacity)
+        pos_onehot = jax.nn.one_hot(
+            (position - 1.0).astype(jnp.int32), capacity, dtype=jnp.float32
+        )  # [T, E, C]
+        dispatch = pos_onehot * within[..., None].astype(jnp.float32)
+        combine = dispatch * expert_prob[:, None, None]
+
+        # Expert queues: [E, C, H] — the einsum the partitioner turns into
+        # the expert all-to-all when E is sharded.
+        expert_in = jnp.einsum(
+            "tec,th->ech", dispatch.astype(self.dtype), tokens.astype(self.dtype)
+        )
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(), (e, h, self.mlp_dim),
+            jnp.float32,
+        )
+        b_in = self.param("b_in", nn.initializers.zeros_init(),
+                          (e, self.mlp_dim), jnp.float32)
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(), (e, self.mlp_dim, h),
+            jnp.float32,
+        )
+        b_out = self.param("b_out", nn.initializers.zeros_init(), (e, h),
+                           jnp.float32)
+        hidden = nn.gelu(
+            jnp.einsum("ech,ehm->ecm", expert_in, w_in.astype(self.dtype))
+            + b_in[:, None, :].astype(self.dtype)
+        )
+        expert_out = (
+            jnp.einsum("ecm,emh->ech", hidden, w_out.astype(self.dtype))
+            + b_out[:, None, :].astype(self.dtype)
+        )
+        y = jnp.einsum(
+            "tec,ech->th", combine.astype(self.dtype), expert_out
+        ).reshape(b, s, h)
+
+        # Switch load-balance loss: E * Σ_e (fraction routed to e) ×
+        # (mean router prob of e); minimised by uniform routing.
+        frac = onehot.mean(axis=0)
+        mean_prob = probs.mean(axis=0)
+        self.sow("aux_loss", "load_balance",
+                 e * jnp.sum(frac * mean_prob))
+        return y

@@ -95,13 +95,16 @@ def _classification_task(num_classes: int, model_name: str, image_size: int,
 def _masked_lm_task(vocab_size: Optional[int], model_name: str, seq_len: int,
                     mask_prob: float = 0.15, mask_id: int = 1,
                     attention_fn: Optional[Callable] = None,
-                    remat: bool = False) -> Task:
+                    remat: bool = False, num_experts: int = 0,
+                    moe_every: int = 2,
+                    aux_loss_weight: float = 0.01) -> Task:
     ctor = {"bert_base": bert_base, "bert_small": bert_small}.get(model_name)
     if ctor is None:
         raise ValueError(f"Invalid model name: {model_name} "
                          "(have ['bert_base', 'bert_small'])")
     model = ctor(vocab_size=vocab_size or 30522, max_len=seq_len,
-                 attention_fn=attention_fn, remat=remat)
+                 attention_fn=attention_fn, remat=remat,
+                 num_experts=num_experts, moe_every=moe_every)
 
     def init_variables(rng):
         ids = jnp.zeros((1, seq_len), jnp.int32)
@@ -125,18 +128,29 @@ def _masked_lm_task(vocab_size: Optional[int], model_name: str, seq_len: int,
             positions = jnp.arange(ids.shape[1])
             mlm_mask = ((positions % stride) == 0)[None, :] & (mask > 0)
         corrupted = jnp.where(mlm_mask, mask_id, ids)
-        logits = model.apply(variables, corrupted, mask, train=train)
-        return (logits, mlm_mask), None
+        aux = jnp.zeros((), jnp.float32)
+        if train and num_experts > 0:
+            # MoE blocks sow their switch load-balance terms; collect them.
+            logits, sown = model.apply(
+                variables, corrupted, mask, train=True, mutable=["aux_loss"]
+            )
+            for leaf in jax.tree_util.tree_leaves(sown.get("aux_loss", {})):
+                aux = aux + leaf
+        else:
+            logits = model.apply(variables, corrupted, mask, train=train)
+        return (logits, mlm_mask, aux), None
 
     def loss(outputs, batch):
-        logits, mlm_mask = outputs
+        logits, mlm_mask, aux = outputs
         targets = batch["input_ids"].astype(jnp.int32)
         raw = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
         w = mlm_mask.astype(jnp.float32)
-        return (raw * w).sum() / jnp.maximum(w.sum(), 1.0)
+        return (raw * w).sum() / jnp.maximum(w.sum(), 1.0) + (
+            aux_loss_weight * aux
+        )
 
     def metric(outputs, batch):
-        logits, mlm_mask = outputs
+        logits, mlm_mask, _aux = outputs
         targets = batch["input_ids"].astype(jnp.int32)
         hit = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
         w = mlm_mask.astype(jnp.float32)
@@ -214,6 +228,8 @@ def get_task(
     augment: bool = True,
     attention_fn: Optional[Callable] = None,
     remat: bool = False,
+    num_experts: int = 0,
+    moe_every: int = 2,
 ) -> Task:
     """``vocab_size=None`` means "the model's own default" (bert_*: 30522,
     clip_tiny: 1000, clip_resnet50_bert: 30522); explicit values always
@@ -224,7 +240,8 @@ def get_task(
         )
     if task_type == "masked_lm":
         return _masked_lm_task(vocab_size, model_name or "bert_base", seq_len,
-                               attention_fn=attention_fn, remat=remat)
+                               attention_fn=attention_fn, remat=remat,
+                               num_experts=num_experts, moe_every=moe_every)
     if task_type == "contrastive":
         return _contrastive_task(
             model_name or "clip_resnet50_bert", image_size, seq_len,

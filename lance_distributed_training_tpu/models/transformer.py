@@ -65,6 +65,8 @@ class EncoderBlock(nn.Module):
     mlp_dim: int
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
+    num_experts: int = 0  # >0: switch-MoE MLP instead of dense (expert parallel)
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -74,11 +76,17 @@ class EncoderBlock(nn.Module):
                           attention_fn=self.attention_fn, name="attn")(y, mask)
         x = x + y
         y = norm(name="ln_mlp")(x)
-        y = nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=jnp.float32,
-                     name="mlp_in")(y)
-        y = nn.gelu(y)
-        y = nn.Dense(x.shape[-1], dtype=self.dtype, param_dtype=jnp.float32,
-                     name="mlp_out")(y)
+        if self.num_experts > 0:
+            from .moe import MoEMLP
+
+            y = MoEMLP(self.num_experts, self.mlp_dim,
+                       self.capacity_factor, self.dtype, name="moe")(y)
+        else:
+            y = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="mlp_in")(y)
+            y = nn.gelu(y)
+            y = nn.Dense(x.shape[-1], dtype=self.dtype,
+                         param_dtype=jnp.float32, name="mlp_out")(y)
         return x + y
 
 
@@ -100,6 +108,9 @@ class TransformerEncoder(nn.Module):
     remat: bool = False
     attention_fn: Optional[Callable] = None
     head: str = "mlm"  # "mlm" → tied vocab logits; "none" → hidden states
+    num_experts: int = 0  # >0: MoE MLP on every `moe_every`-th block
+    moe_every: int = 2
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, train: bool = True):
@@ -122,8 +133,16 @@ class TransformerEncoder(nn.Module):
         if self.remat:
             block = nn.remat(EncoderBlock, static_argnums=())
         for i in range(self.num_layers):
+            # MoE on every moe_every-th block (Switch/GShard convention:
+            # alternate dense and expert layers).
+            moe_here = (
+                self.num_experts > 0 and i % self.moe_every == self.moe_every - 1
+            )
             x = block(self.num_heads, self.mlp_dim, self.dtype,
-                      attention_fn=self.attention_fn, name=f"layer_{i}")(x, mask)
+                      attention_fn=self.attention_fn,
+                      num_experts=self.num_experts if moe_here else 0,
+                      capacity_factor=self.capacity_factor,
+                      name=f"layer_{i}")(x, mask)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_final")(x)
         if self.head == "none":

@@ -65,6 +65,11 @@ TRANSFORMER_RULES: Tuple[Tuple[str, P], ...] = (
     (r"mlp_out/bias$", P()),
     # Vocab-parallel embedding; the tied head (embed.attend) inherits it.
     (r"tok_embed/embedding$", P("model", None)),
+    # Expert parallelism: the MoE expert dim rides the same 'model' axis —
+    # each tp group holds num_experts/tp experts; the dispatch einsum becomes
+    # the expert all-to-all. Router stays replicated (unmatched → P()).
+    (r"moe/w_(in|out)$", P("model", None, None)),
+    (r"moe/b_(in|out)$", P("model", None)),
 )
 
 # The reference's model family (ResNet-50, modelling/classification.py:6-10)

@@ -102,6 +102,8 @@ class TrainConfig:
     seq_parallelism: int = 1  # context-parallel degree ('seq' axis, ring attn)
     remat: bool = False  # rematerialize transformer blocks (long-context)
     flash_attention: bool = False  # Pallas fused attention (TPU; dense elsewhere)
+    num_experts: int = 0  # >0: switch-MoE transformer blocks (expert parallel)
+    moe_every: int = 2  # MoE on every Nth block
     # -- aux subsystems the reference lacks (SURVEY.md §5) --
     checkpoint_dir: Optional[str] = None  # orbax save/restore root
     checkpoint_every: int = 1  # save every N epochs
@@ -140,6 +142,8 @@ def _task_from_config(config: TrainConfig, mesh=None) -> Task:
         augment=config.augment,
         attention_fn=attention_fn,
         remat=config.remat,
+        num_experts=config.num_experts,
+        moe_every=config.moe_every,
     )
 
 

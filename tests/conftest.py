@@ -18,6 +18,14 @@ import jax
 # "axon,cpu" at interpreter start, overriding the env var — pin it back.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compile cache: the suite's dominant cost is re-jitting the same
+# train steps; cache them across tests and across runs.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import io
 import sys
 
