@@ -15,6 +15,10 @@ from .mesh import (  # noqa: F401
     sync_global_devices,
 )
 from .ring_attention import make_ring_attention, ring_attention  # noqa: F401
+from .pipeline_parallel import (  # noqa: F401
+    pipeline_apply,
+    stack_stage_params,
+)
 from .sharding import (  # noqa: F401
     TRANSFORMER_RULES,
     batch_partition_spec,

@@ -1,0 +1,128 @@
+"""GPipe pipeline parallelism: forward exactness vs sequential stages,
+gradient exactness, dp×pp composition, and a pipelined train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from lance_distributed_training_tpu.parallel.pipeline_parallel import (
+    pipeline_apply,
+    stack_stage_params,
+)
+
+HID = 16
+
+
+def _mesh(pipe=4, data=1):
+    devs = np.array(jax.devices()[: pipe * data])
+    if data > 1:
+        return Mesh(devs.reshape(data, pipe), ("data", "pipe"))
+    return Mesh(devs.reshape(pipe), ("pipe",))
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _stages(n, seed=0):
+    gen = np.random.default_rng(seed)
+    return [
+        {"w": jnp.asarray(gen.standard_normal((HID, HID)) * 0.3, jnp.float32),
+         "b": jnp.asarray(gen.standard_normal(HID) * 0.1, jnp.float32)}
+        for _ in range(n)
+    ]
+
+
+def _sequential(stages, x):
+    for p in stages:
+        x = _stage_fn(p, x)
+    return x
+
+
+def test_pipeline_matches_sequential():
+    stages = _stages(4)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((24, HID)),
+                    jnp.float32)
+    out = pipeline_apply(_stage_fn, stacked, x, _mesh(4), n_microbatches=6)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_sequential(stages, x)), rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_pipeline_gradients_match_sequential():
+    stages = _stages(4, seed=2)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((8, HID)),
+                    jnp.float32)
+    mesh = _mesh(4)
+
+    def loss_pp(sp):
+        return (pipeline_apply(_stage_fn, sp, x, mesh, 4) ** 2).sum()
+
+    def loss_seq(params_list):
+        return (_sequential(params_list, x) ** 2).sum()
+
+    g_pp = jax.grad(loss_pp)(stacked)
+    g_seq = stack_stage_params(jax.grad(loss_seq)(stages))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        ),
+        g_pp, g_seq,
+    )
+
+
+def test_pipeline_composes_with_data_parallelism():
+    stages = _stages(4, seed=4)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(np.random.default_rng(5).standard_normal((16, HID)),
+                    jnp.float32)
+    out = pipeline_apply(_stage_fn, stacked, x, _mesh(pipe=4, data=2),
+                         n_microbatches=2)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_sequential(stages, x)), rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_pipelined_train_step_learns():
+    """SGD on a pipelined 4-stage MLP regression: loss decreases."""
+    import optax
+
+    mesh = _mesh(4)
+    stages = _stages(4, seed=6)
+    stacked = stack_stage_params(stages)
+    gen = np.random.default_rng(7)
+    x = jnp.asarray(gen.standard_normal((32, HID)), jnp.float32)
+    y = jnp.asarray(gen.standard_normal((32, HID)), jnp.float32)
+    tx = optax.sgd(0.1)
+    opt_state = tx.init(stacked)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            pred = pipeline_apply(_stage_fn, p, x, mesh, 4)
+            return ((pred - y) ** 2).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    params = stacked
+    for _ in range(20):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.95
+
+
+def test_pipeline_rejects_bad_microbatching():
+    import pytest
+
+    stacked = stack_stage_params(_stages(4))
+    x = jnp.zeros((10, HID), jnp.float32)
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline_apply(_stage_fn, stacked, x, _mesh(4), n_microbatches=3)
