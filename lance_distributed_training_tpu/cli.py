@@ -78,6 +78,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ignore existing checkpoints, start fresh")
     p.add_argument("--profile_dir", type=str, default=None,
                    help="capture a jax.profiler trace of early steps")
+    p.add_argument("--coordinator_address", type=str, default=None,
+                   help="host:port of process 0 for multi-host rendezvous "
+                        "(torchrun MASTER_ADDR equivalent)")
+    p.add_argument("--num_processes", type=int, default=None,
+                   help="multi-host process count (WORLD_SIZE equivalent)")
+    p.add_argument("--process_id", type=int, default=None,
+                   help="this host's index (RANK equivalent)")
     p.add_argument("--backend", type=str, default=None,
                    choices=["tpu", "cpu"],
                    help="force a JAX platform (the BASELINE --backend knob); "
@@ -151,6 +158,9 @@ def main(argv=None) -> dict:
         checkpoint_every=args.checkpoint_every,
         resume=not args.no_resume,
         profile_dir=args.profile_dir,
+        coordinator_address=args.coordinator_address,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
     )
     return train(config)
 

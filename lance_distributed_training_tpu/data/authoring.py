@@ -279,3 +279,51 @@ def create_text_token_dataset(
         gen(), output_path, schema=schema, mode="overwrite",
         max_rows_per_file=fragment_size,
     )
+
+
+def main(argv=None) -> None:
+    """Dataset-authoring CLI — the ``create_datasets/classification.py``
+    script equivalent (``/root/reference/create_datasets/classification.py:
+    69-70``, flags ``:13-17``)::
+
+        python -m lance_distributed_training_tpu.data.authoring \
+            --root_path /data/food101_files --output_path /data/food101.ldt \
+            --fragment_size 12500
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(description="Author a columnar dataset")
+    sub = p.add_subparsers(dest="kind", required=False)
+
+    folder = sub.add_parser("folder", help="image-folder tree → dataset")
+    folder.add_argument("--root_path", required=True)
+    folder.add_argument("--output_path", required=True)
+    folder.add_argument("--fragment_size", type=int, default=12500)
+    folder.add_argument("--batch_size", type=int, default=1024)
+    folder.add_argument("--reencode_jpeg_quality", type=int, default=None)
+    folder.add_argument("--shuffle_seed", type=int, default=None)
+
+    synth = sub.add_parser("synthetic", help="synthetic classification dataset")
+    synth.add_argument("--output_path", required=True)
+    synth.add_argument("--rows", type=int, required=True)
+    synth.add_argument("--num_classes", type=int, default=101)
+    synth.add_argument("--image_size", type=int, default=224)
+    synth.add_argument("--fragment_size", type=int, default=12500)
+
+    args = p.parse_args(argv)
+    if args.kind == "synthetic":
+        create_synthetic_classification_dataset(
+            args.output_path, args.rows, num_classes=args.num_classes,
+            image_size=args.image_size, fragment_size=args.fragment_size,
+        )
+    else:
+        create_dataset_from_image_folder(
+            args.root_path, args.output_path,
+            fragment_size=args.fragment_size, batch_size=args.batch_size,
+            reencode_jpeg_quality=args.reencode_jpeg_quality,
+            shuffle_seed=args.shuffle_seed,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -32,14 +32,31 @@ __all__ = [
 ]
 
 
-def maybe_initialize_distributed() -> None:
+def maybe_initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
     """Multi-host rendezvous — the ``init_process_group`` equivalent.
 
-    Safe no-op when single-process (the reference's ``--no_ddp`` escape hatch,
-    ``lance_iterable.py:75,145,149-151``, is the default here: topology is
-    discovered, never required).
+    Explicit args mirror torchrun's ``MASTER_ADDR``/``WORLD_SIZE``/``RANK``
+    injection (``/root/reference/lance_iterable.py:154-156``); with no args,
+    rendezvous happens only when the environment provides it
+    (``JAX_COORDINATOR_ADDRESS``, or a TPU pod runtime where
+    ``jax.distributed.initialize()`` self-discovers). Safe no-op when
+    single-process — the reference's ``--no_ddp`` escape hatch
+    (``lance_iterable.py:75,145,149-151``) is the default here: topology is
+    discovered, never required.
     """
-    if os.environ.get("JAX_COORDINATOR_ADDRESS") and jax.process_count() == 1:
+    if jax.process_count() > 1:
+        return  # already initialized
+    if coordinator_address is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif os.environ.get("JAX_COORDINATOR_ADDRESS"):
         jax.distributed.initialize()
 
 

@@ -109,6 +109,10 @@ class TrainConfig:
     checkpoint_every: int = 1  # save every N epochs
     resume: bool = True  # restore the latest checkpoint if one exists
     profile_dir: Optional[str] = None  # jax.profiler trace of early steps
+    # -- multi-host rendezvous (torchrun MASTER_ADDR/RANK/WORLD_SIZE parity) --
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
 
 
 def _task_from_config(config: TrainConfig, mesh=None) -> Task:
@@ -384,7 +388,9 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
 
 def train(config: TrainConfig) -> dict:
     """The single training entry point. Returns final metrics."""
-    maybe_initialize_distributed()
+    maybe_initialize_distributed(
+        config.coordinator_address, config.num_processes, config.process_id
+    )
     devices = jax.devices()
     if config.no_ddp:
         devices = devices[:1]
