@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequence/context-parallel degree (ring attention)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize transformer blocks (long-context)")
+    p.add_argument("--pipeline_parallelism", type=int, default=1,
+                   help="GPipe pipeline stages (the 'pipe' mesh axis)")
+    p.add_argument("--pp_microbatches", type=int, default=4,
+                   help="microbatches per pipeline round")
     p.add_argument("--num_experts", type=int, default=0,
                    help=">0: switch-MoE transformer blocks; experts shard "
                         "over the 'model' mesh axis (expert parallelism)")
@@ -154,6 +158,8 @@ def main(argv=None) -> dict:
         flash_attention=args.flash_attention,
         num_experts=args.num_experts,
         moe_every=args.moe_every,
+        pipeline_parallelism=args.pipeline_parallelism,
+        pp_microbatches=args.pp_microbatches,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         resume=not args.no_resume,

@@ -161,6 +161,112 @@ def _masked_lm_task(vocab_size: Optional[int], model_name: str, seq_len: int,
                 metric_name="masked_token_accuracy")
 
 
+# ------------------------------------------------------- pipelined masked LM
+_BERT_DIMS = {
+    # (hidden, layers, heads, mlp_dim) — mirrors bert_base / bert_small.
+    "bert_base": (768, 12, 12, 3072),
+    "bert_small": (256, 4, 4, 1024),
+}
+
+
+def _pipelined_masked_lm_task(
+    vocab_size: Optional[int],
+    model_name: str,
+    seq_len: int,
+    mesh,
+    n_microbatches: int,
+    mask_prob: float = 0.15,
+    mask_id: int = 1,
+    dtype=jnp.bfloat16,
+) -> Task:
+    """Masked-LM with the encoder stack run through the GPipe pipeline
+    (:mod:`..parallel.pipeline_parallel`) over the mesh's ``'pipe'`` axis.
+
+    The L encoder blocks' params are stacked ``[L, ...]`` and sharded
+    ``P('pipe')`` (each stage holds ``L/pp`` layers and scans them);
+    embedding/head stay replicated outside the pipeline. Designed for PACKED
+    sequences (the C4 config,
+    :func:`..data.authoring.create_text_token_dataset` with ``pack=True``):
+    attention runs unmasked inside the pipeline, so padded rows should be
+    rare (only a dataset's final partial pack); the MLM loss still respects
+    ``attention_mask``.
+    """
+    from ..parallel.pipeline_parallel import pipeline_apply, stack_stage_params
+    from .transformer import EncoderBlock
+
+    if model_name not in _BERT_DIMS:
+        raise ValueError(f"Invalid model name: {model_name} "
+                         f"(have {sorted(_BERT_DIMS)})")
+    hidden, layers, heads, mlp_dim = _BERT_DIMS[model_name]
+    vocab = vocab_size or 30522
+    pp = mesh.shape.get("pipe", 1)
+    if layers % pp:
+        raise ValueError(f"{layers} layers not divisible by pipe={pp}")
+    block = EncoderBlock(num_heads=heads, mlp_dim=mlp_dim, dtype=dtype)
+
+    def init_variables(rng):
+        rngs = jax.random.split(rng, layers + 2)
+        dummy = jnp.zeros((1, seq_len, hidden), dtype)
+        blocks = stack_stage_params(
+            [block.init(rngs[i], dummy)["params"] for i in range(layers)]
+        )
+        init = jax.nn.initializers.normal(0.02)
+        return {
+            "params": {
+                "blocks": blocks,
+                "tok_embed": init(rngs[-2], (vocab, hidden), jnp.float32),
+                "pos_embed": init(rngs[-1], (seq_len, hidden), jnp.float32),
+                "ln_scale": jnp.ones((hidden,), jnp.float32),
+                "ln_bias": jnp.zeros((hidden,), jnp.float32),
+            }
+        }
+
+    def stage_fn(stage_params, h):
+        return jax.lax.scan(
+            lambda carry, q: (block.apply({"params": q}, carry, None), None),
+            h,
+            stage_params,
+        )[0]
+
+    def forward(variables, batch, train, rng):
+        p = variables["params"]
+        ids = batch["input_ids"].astype(jnp.int32)
+        valid = batch["attention_mask"] > 0
+        if train and rng is not None:
+            mlm_mask = jax.random.bernoulli(rng, mask_prob, ids.shape) & valid
+        else:
+            stride = max(int(round(1.0 / mask_prob)), 1)
+            positions = jnp.arange(ids.shape[1])
+            mlm_mask = ((positions % stride) == 0)[None, :] & valid
+        corrupted = jnp.where(mlm_mask, mask_id, ids)
+        x = p["tok_embed"][corrupted].astype(dtype)
+        x = x + p["pos_embed"][None, : ids.shape[1]].astype(dtype)
+        x = pipeline_apply(stage_fn, p["blocks"], x, mesh, n_microbatches)
+        x32 = x.astype(jnp.float32)
+        mean = x32.mean(-1, keepdims=True)
+        var = ((x32 - mean) ** 2).mean(-1, keepdims=True)
+        x32 = (x32 - mean) / jnp.sqrt(var + 1e-6) * p["ln_scale"] + p["ln_bias"]
+        logits = x32 @ p["tok_embed"].T  # tied head
+        return (logits, mlm_mask, jnp.zeros((), jnp.float32)), None
+
+    def loss(outputs, batch):
+        logits, mlm_mask, _aux = outputs
+        targets = batch["input_ids"].astype(jnp.int32)
+        raw = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        w = mlm_mask.astype(jnp.float32)
+        return (raw * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+    def metric(outputs, batch):
+        logits, mlm_mask, _aux = outputs
+        targets = batch["input_ids"].astype(jnp.int32)
+        hit = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+        w = mlm_mask.astype(jnp.float32)
+        return (hit * w).sum(-1) / jnp.maximum(w.sum(-1), 1.0)
+
+    return Task("masked_lm_pp", block, init_variables, forward, loss, metric,
+                metric_name="masked_token_accuracy")
+
+
 # ---------------------------------------------------------------- contrastive
 def _contrastive_task(model_name: str, image_size: int, seq_len: int,
                       vocab_size: Optional[int], augment: bool = True) -> Task:
@@ -230,6 +336,9 @@ def get_task(
     remat: bool = False,
     num_experts: int = 0,
     moe_every: int = 2,
+    pipeline_parallelism: int = 1,
+    pp_microbatches: int = 4,
+    mesh=None,
 ) -> Task:
     """``vocab_size=None`` means "the model's own default" (bert_*: 30522,
     clip_tiny: 1000, clip_resnet50_bert: 30522); explicit values always
@@ -239,6 +348,16 @@ def get_task(
             num_classes, model_name or "resnet50", image_size, augment
         )
     if task_type == "masked_lm":
+        if pipeline_parallelism > 1:
+            if attention_fn is not None or num_experts:
+                raise ValueError(
+                    "pipeline_parallelism composes with dp only "
+                    "(not seq/flash/moe) in this release"
+                )
+            return _pipelined_masked_lm_task(
+                vocab_size, model_name or "bert_base", seq_len, mesh,
+                pp_microbatches,
+            )
         return _masked_lm_task(vocab_size, model_name or "bert_base", seq_len,
                                attention_fn=attention_fn, remat=remat,
                                num_experts=num_experts, moe_every=moe_every)

@@ -67,6 +67,8 @@ def get_mesh(
     model_parallelism: int = 1,
     seq_axis: Optional[str] = "seq",
     seq_parallelism: int = 1,
+    pipe_axis: Optional[str] = "pipe",
+    pipe_parallelism: int = 1,
 ) -> Mesh:
     """Build the device mesh.
 
@@ -82,20 +84,26 @@ def get_mesh(
     n = len(devices)
     mp = model_parallelism if model_axis is not None else 1
     sp = seq_parallelism if seq_axis is not None else 1
-    if mp < 1 or sp < 1:
-        raise ValueError(f"parallelism degrees must be >=1, got {mp=} {sp=}")
-    if n % (mp * sp):
+    pp = pipe_parallelism if pipe_axis is not None else 1
+    if mp < 1 or sp < 1 or pp < 1:
         raise ValueError(
-            f"{n} devices not divisible by model_parallelism*seq_parallelism="
-            f"{mp * sp}"
+            f"parallelism degrees must be >=1, got {mp=} {sp=} {pp=}"
         )
-    shape, axes = [n // (mp * sp)], [data_axis]
+    if n % (mp * sp * pp):
+        raise ValueError(
+            f"{n} devices not divisible by model*seq*pipe parallelism="
+            f"{mp * sp * pp}"
+        )
+    shape, axes = [n // (mp * sp * pp)], [data_axis]
     if mp > 1:
         shape.append(mp)
         axes.append(model_axis)
     if sp > 1:
         shape.append(sp)
         axes.append(seq_axis)
+    if pp > 1:
+        shape.append(pp)
+        axes.append(pipe_axis)
     return Mesh(np.array(devices).reshape(shape), tuple(axes))
 
 

@@ -56,8 +56,13 @@ def pipeline_apply(
     ----------
     stage_fn: ``(stage_params, microbatch) -> microbatch`` — one stage's
         compute; input/output shapes must match so activations can ring.
-    stacked_params: pytree with leading stage axis ``n_stages`` (see
-        :func:`stack_stage_params`), sharded ``P(pipe_axis)``.
+        ``stage_params`` is this stage's slice of ``stacked_params`` WITH the
+        leading axis kept: length 1 when the stack has one entry per stage,
+        length ``L/n_stages`` when pipelining ``L`` stacked layers over fewer
+        stages (the stage_fn then scans its local layers).
+    stacked_params: pytree with leading stage axis — ``n_stages`` or a
+        multiple of it (see :func:`stack_stage_params`), sharded
+        ``P(pipe_axis)``.
     x: global batch ``[B, ...]``; composes with data parallelism — when the
         mesh also has ``data_axis``, the batch dim is sharded over it and
         each data group runs its own pipeline. The per-data-shard batch must
@@ -85,9 +90,9 @@ def pipeline_apply(
         out_specs=x_spec,
     )
     def _run(local_params, x_full):
-        # Inside shard_map: local_params has leading dim 1 (this stage);
-        # x_full is this data group's batch shard.
-        my_params = jax.tree_util.tree_map(lambda p: p[0], local_params)
+        # Inside shard_map: local_params keeps its leading (now local) stage
+        # axis — length L/n_stages; x_full is this data group's batch shard.
+        my_params = local_params
         stage = lax.axis_index(pipe_axis)
         mb = x_full.shape[0] // n_microbatches
         micro = x_full.reshape((n_microbatches, mb) + x_full.shape[1:])

@@ -39,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     "TRANSFORMER_RULES",
     "RESNET_RULES",
+    "PIPELINE_RULES",
     "rules_for_task",
     "partition_specs",
     "state_shardings",
@@ -76,9 +77,17 @@ TRANSFORMER_RULES: Tuple[Tuple[str, P], ...] = (
 # is pure data-parallel: every parameter replicated.
 RESNET_RULES: Tuple[Tuple[str, P], ...] = ()
 
+# Pipelined transformer (tasks._pipelined_masked_lm_task): the stacked block
+# params' leading layer axis shards over 'pipe'; everything else replicated.
+PIPELINE_RULES: Tuple[Tuple[str, P], ...] = (
+    (r"blocks/", P("pipe")),
+)
+
 
 def rules_for_task(task_name: str) -> Tuple[Tuple[str, P], ...]:
     """Default partition rules per task family."""
+    if task_name == "masked_lm_pp":
+        return PIPELINE_RULES
     if task_name in ("masked_lm", "contrastive"):
         return TRANSFORMER_RULES
     return RESNET_RULES

@@ -104,6 +104,8 @@ class TrainConfig:
     flash_attention: bool = False  # Pallas fused attention (TPU; dense elsewhere)
     num_experts: int = 0  # >0: switch-MoE transformer blocks (expert parallel)
     moe_every: int = 2  # MoE on every Nth block
+    pipeline_parallelism: int = 1  # GPipe stages over a 'pipe' mesh axis
+    pp_microbatches: int = 4  # microbatches per pipeline round
     # -- aux subsystems the reference lacks (SURVEY.md §5) --
     checkpoint_dir: Optional[str] = None  # orbax save/restore root
     checkpoint_every: int = 1  # save every N epochs
@@ -130,6 +132,11 @@ def _task_from_config(config: TrainConfig, mesh=None) -> Task:
         from .parallel.ring_attention import make_ring_attention
 
         attention_fn = make_ring_attention(mesh)
+    elif config.pipeline_parallelism > 1:
+        if config.task_type != "masked_lm":
+            raise ValueError(
+                "pipeline_parallelism>1 requires a sequence model (masked_lm)"
+            )
     elif config.flash_attention:
         if config.task_type != "masked_lm":
             raise ValueError("flash_attention requires a sequence model")
@@ -148,6 +155,9 @@ def _task_from_config(config: TrainConfig, mesh=None) -> Task:
         remat=config.remat,
         num_experts=config.num_experts,
         moe_every=config.moe_every,
+        pipeline_parallelism=config.pipeline_parallelism,
+        pp_microbatches=config.pp_microbatches,
+        mesh=mesh,
     )
 
 
@@ -398,6 +408,7 @@ def train(config: TrainConfig) -> dict:
         devices,
         model_parallelism=config.model_parallelism,
         seq_parallelism=config.seq_parallelism,
+        pipe_parallelism=config.pipeline_parallelism,
     )
 
     dataset = (
@@ -414,7 +425,11 @@ def train(config: TrainConfig) -> dict:
     rng, init_rng = jax.random.split(rng)
     from .parallel.sharding import batch_partition_spec, rules_for_task
 
-    rules = rules_for_task(task.name) if config.model_parallelism > 1 else ()
+    rules = (
+        rules_for_task(task.name)
+        if (config.model_parallelism > 1 or config.pipeline_parallelism > 1)
+        else ()
+    )
     state, state_sharding = create_sharded_train_state(
         init_rng, task, config, mesh, rules
     )

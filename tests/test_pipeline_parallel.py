@@ -21,8 +21,16 @@ def _mesh(pipe=4, data=1):
     return Mesh(devs.reshape(pipe), ("pipe",))
 
 
+def _layer(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
 def _stage_fn(params, x):
-    return jnp.tanh(x @ params["w"] + params["b"])
+    """Stage = scan over this stage's local slice of stacked layers."""
+    def body(h, p):
+        return _layer(p, h), None
+
+    return jax.lax.scan(body, x, params)[0]
 
 
 def _stages(n, seed=0):
@@ -36,7 +44,7 @@ def _stages(n, seed=0):
 
 def _sequential(stages, x):
     for p in stages:
-        x = _stage_fn(p, x)
+        x = _layer(p, x)
     return x
 
 
@@ -126,3 +134,16 @@ def test_pipeline_rejects_bad_microbatching():
     x = jnp.zeros((10, HID), jnp.float32)
     with pytest.raises(ValueError, match="not divisible"):
         pipeline_apply(_stage_fn, stacked, x, _mesh(4), n_microbatches=3)
+
+
+def test_multiple_layers_per_stage():
+    """8 stacked layers over 4 stages: each device scans its 2 local layers."""
+    stages = _stages(8, seed=8)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(np.random.default_rng(9).standard_normal((12, HID)),
+                    jnp.float32)
+    out = pipeline_apply(_stage_fn, stacked, x, _mesh(4), n_microbatches=4)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_sequential(stages, x)), rtol=1e-5,
+        atol=1e-5,
+    )
