@@ -112,13 +112,16 @@ def run_config(name: str) -> dict:
         unit, value = "images/sec/chip", m["images_per_sec_per_chip"]
         vs = None
 
-    elif name == "food101-resnet50-iter":
-        # bench.py's headline twin: iterable loader + sharded-batch plan.
+    elif name in ("food101-resnet50-iter", "imagenet-fragment"):
+        # Shared image-benchmark recipe; the two configs differ in class
+        # count, sampler (sharded-batch vs whole-fragment reads, reference
+        # README.md:127-128), and fragment granularity.
         from lance_distributed_training_tpu.data import (
             create_synthetic_classification_dataset,
         )
         import jax
 
+        imagenet = name == "imagenet-fragment"
         accel = _on_accelerator()
         model = "resnet50" if accel else "resnet18"
         per_chip = 16 if SMALL else (128 if accel else 32)
@@ -126,50 +129,24 @@ def run_config(name: str) -> dict:
         steps = 3 if SMALL else 8
         size = 96 if SMALL else 224
         rows = batch * steps
+        num_classes = 1000 if imagenet else 101
         create_synthetic_classification_dataset(
-            uri, rows, num_classes=101, image_size=size,
-            fragment_size=max(rows // 4, 1),
+            uri, rows, num_classes=num_classes, image_size=size,
+            fragment_size=max(rows // (8 if imagenet else 4), 1),
         )
         cfg = TrainConfig(
-            dataset_path=uri, num_classes=101, model_name=model,
-            image_size=size, batch_size=batch, sampler_type="batch",
+            dataset_path=uri, num_classes=num_classes, model_name=model,
+            image_size=size, batch_size=batch,
+            sampler_type="fragment" if imagenet else "batch",
             loader_style="iterable", **common,
         )
         m = _train_metrics(cfg, steps)
         unit, value = "images/sec/chip", m["images_per_sec_per_chip"]
         vs = (
             round(value / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3)
-            if accel and model == "resnet50"
+            if not imagenet and accel and model == "resnet50"
             else None
         )
-
-    elif name == "imagenet-fragment":
-        # ImageNet-shaped: 1000 classes, whole-fragment sequential reads
-        # (ShardedFragmentSampler parity, reference README.md:128).
-        from lance_distributed_training_tpu.data import (
-            create_synthetic_classification_dataset,
-        )
-        import jax
-
-        accel = _on_accelerator()
-        model = "resnet50" if accel else "resnet18"
-        per_chip = 16 if SMALL else (128 if accel else 32)
-        batch = per_chip * len(jax.devices())
-        steps = 3 if SMALL else 8
-        size = 96 if SMALL else 224
-        rows = batch * steps
-        create_synthetic_classification_dataset(
-            uri, rows, num_classes=1000, image_size=size,
-            fragment_size=max(rows // 8, 1),
-        )
-        cfg = TrainConfig(
-            dataset_path=uri, num_classes=1000, model_name=model,
-            image_size=size, batch_size=batch, sampler_type="fragment",
-            loader_style="iterable", **common,
-        )
-        m = _train_metrics(cfg, steps)
-        unit, value = "images/sec/chip", m["images_per_sec_per_chip"]
-        vs = None
 
     elif name == "c4-bert":
         # Packed token columns → masked-LM BERT (C4 config). bert_base on an

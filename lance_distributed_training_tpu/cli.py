@@ -100,6 +100,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
+    if args.coordinator_address:
+        # Multi-host rendezvous must precede ANY backend query (including the
+        # --backend tpu device probe below).
+        from .parallel.mesh import maybe_initialize_distributed
+
+        maybe_initialize_distributed(
+            args.coordinator_address, args.num_processes, args.process_id
+        )
     if args.backend == "cpu":
         import jax
 

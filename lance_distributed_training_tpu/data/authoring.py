@@ -286,14 +286,14 @@ def main(argv=None) -> None:
     script equivalent (``/root/reference/create_datasets/classification.py:
     69-70``, flags ``:13-17``)::
 
-        python -m lance_distributed_training_tpu.data.authoring \
+        python -m lance_distributed_training_tpu.data.authoring folder \
             --root_path /data/food101_files --output_path /data/food101.ldt \
             --fragment_size 12500
     """
     import argparse
 
     p = argparse.ArgumentParser(description="Author a columnar dataset")
-    sub = p.add_subparsers(dest="kind", required=False)
+    sub = p.add_subparsers(dest="kind", required=True)
 
     folder = sub.add_parser("folder", help="image-folder tree → dataset")
     folder.add_argument("--root_path", required=True)
@@ -316,7 +316,7 @@ def main(argv=None) -> None:
             args.output_path, args.rows, num_classes=args.num_classes,
             image_size=args.image_size, fragment_size=args.fragment_size,
         )
-    else:
+    else:  # "folder" — the only other registered subcommand
         create_dataset_from_image_folder(
             args.root_path, args.output_path,
             fragment_size=args.fragment_size, batch_size=args.batch_size,

@@ -135,7 +135,9 @@ class WorkerPool:
                 fut.cancel()
 
     def shutdown(self) -> None:
-        self._pool.shutdown(wait=False, cancel_futures=True)
+        # wait=True: join the workers — abandoning spawn children mid-task
+        # makes them die noisily ("Fatal Python error") at interpreter exit.
+        self._pool.shutdown(wait=True, cancel_futures=True)
 
     def __enter__(self) -> "WorkerPool":
         return self

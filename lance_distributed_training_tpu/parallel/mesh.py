@@ -32,6 +32,9 @@ __all__ = [
 ]
 
 
+_distributed_initialized = False
+
+
 def maybe_initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -47,17 +50,24 @@ def maybe_initialize_distributed(
     single-process — the reference's ``--no_ddp`` escape hatch
     (``lance_iterable.py:75,145,149-151``) is the default here: topology is
     discovered, never required.
+
+    MUST run before anything initializes the XLA backend (jax raises
+    otherwise) — so no ``jax.process_count()``/``jax.devices()`` guards here;
+    idempotence comes from a module flag.
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    global _distributed_initialized
+    if _distributed_initialized:
+        return
     if coordinator_address is not None:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
+        _distributed_initialized = True
     elif os.environ.get("JAX_COORDINATOR_ADDRESS"):
         jax.distributed.initialize()
+        _distributed_initialized = True
 
 
 def get_mesh(

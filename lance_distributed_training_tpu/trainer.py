@@ -119,6 +119,13 @@ class TrainConfig:
 
 def _task_from_config(config: TrainConfig, mesh=None) -> Task:
     attention_fn = None
+    if config.flash_attention and (
+        config.seq_parallelism > 1 or config.pipeline_parallelism > 1
+    ):
+        raise ValueError(
+            "flash_attention cannot combine with seq_parallelism or "
+            "pipeline_parallelism (they select their own attention path)"
+        )
     if config.seq_parallelism > 1:
         if config.task_type != "masked_lm":
             raise ValueError(
@@ -481,6 +488,28 @@ def train(config: TrainConfig) -> dict:
     profiling = False
 
     worker_pool = _make_worker_pool(config, dataset)
+    try:
+        return _train_loop(
+            config, dataset, val_dataset, mesh, state, rng, train_step,
+            eval_step, logger, timer, worker_pool, ckpt, start_epoch,
+            total_start, n_devices, results, global_step, profiling,
+        )
+    finally:
+        if config.profile_dir:
+            try:  # stop a trace left open by a mid-window exception
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        if worker_pool is not None:
+            worker_pool.shutdown()
+        if ckpt is not None:
+            ckpt.close()
+        logger.finish()
+
+
+def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
+                eval_step, logger, timer, worker_pool, ckpt, start_epoch,
+                total_start, n_devices, results, global_step, profiling):
     for epoch in range(start_epoch, config.epochs):
         loader = _build_loader(config, dataset, mesh, epoch, worker_pool)
         timer.reset()
@@ -563,9 +592,4 @@ def train(config: TrainConfig) -> dict:
         )
         results[key] = evaluate(state, loader, eval_step)
         logger.log({key: results[key]})
-    if worker_pool is not None:
-        worker_pool.shutdown()
-    if ckpt is not None:
-        ckpt.close()
-    logger.finish()
     return results
