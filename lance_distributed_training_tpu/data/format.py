@@ -141,11 +141,26 @@ class Dataset:
       ``SafeLanceDataset.__getitem__`` path (``lance_map_style.py:54``).
     """
 
-    def __init__(self, uri: Union[str, os.PathLike]):
+    def __init__(self, uri: Union[str, os.PathLike],
+                 version: Optional[int] = None):
+        """``version`` time-travels to an earlier snapshot via its immutable
+        manifest in ``_versions/`` (every write records one — the Lance
+        versioning model the upstream store provides)."""
         self.uri = str(uri)
-        manifest_path = os.path.join(self.uri, _MANIFEST)
-        if not os.path.exists(manifest_path):
-            raise FileNotFoundError(f"no dataset manifest at {manifest_path}")
+        if version is None:
+            manifest_path = os.path.join(self.uri, _MANIFEST)
+            if not os.path.exists(manifest_path):
+                raise FileNotFoundError(
+                    f"no dataset manifest at {manifest_path}"
+                )
+        else:
+            manifest_path = os.path.join(
+                self.uri, _VERSIONS_DIR, f"{version}.json"
+            )
+            if not os.path.exists(manifest_path):
+                raise FileNotFoundError(
+                    f"no version {version} at {manifest_path}"
+                )
         with open(manifest_path) as f:
             manifest = json.load(f)
         self.version: int = manifest["version"]
@@ -187,14 +202,24 @@ class Dataset:
                 self._readers[fragment_id] = reader
             return reader
 
-    def read_range(self, fragment_id: int, start: int, stop: int) -> pa.Table:
-        """Rows [start, stop) of one fragment (zero-copy slices)."""
-        return self._reader(fragment_id).read_range(start, stop)
+    def read_range(
+        self,
+        fragment_id: int,
+        start: int,
+        stop: int,
+        columns: Optional[Sequence[str]] = None,
+    ) -> pa.Table:
+        """Rows [start, stop) of one fragment (zero-copy slices).
+        ``columns`` projects (zero-copy) — the Lance scanner's column
+        selection."""
+        table = self._reader(fragment_id).read_range(start, stop)
+        return table.select(columns) if columns is not None else table
 
     def scan(
         self,
         fragment_ids: Optional[Sequence[int]] = None,
         batch_size: int = _DEFAULT_CHUNK,
+        columns: Optional[Sequence[str]] = None,
     ) -> Iterator[pa.RecordBatch]:
         """Sequential streaming scan over (selected) fragments, in order."""
         ids = range(len(self.fragments)) if fragment_ids is None else fragment_ids
@@ -202,7 +227,10 @@ class Dataset:
             reader = self._reader(fid)
             for start in range(0, reader.num_rows, batch_size):
                 stop = min(start + batch_size, reader.num_rows)
-                for batch in reader.read_range(start, stop).to_batches():
+                table = reader.read_range(start, stop)
+                if columns is not None:
+                    table = table.select(columns)
+                for batch in table.to_batches():
                     yield batch
 
     def _locate(self, indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -215,7 +243,11 @@ class Dataset:
         local = indices - self._row_offsets[frag_ids]
         return frag_ids, local
 
-    def take(self, indices: Sequence[int]) -> pa.Table:
+    def take(
+        self,
+        indices: Sequence[int],
+        columns: Optional[Sequence[str]] = None,
+    ) -> pa.Table:
         """Random-access global rows, result in the order of ``indices``."""
         indices = np.asarray(indices, dtype=np.int64)
         if indices.size == 0:
@@ -233,7 +265,8 @@ class Dataset:
         combined = pa.concat_tables(pieces)  # row k ↔ original position order[k]
         inverse = np.empty_like(order)
         inverse[order] = np.arange(order.size)
-        return combined.take(pa.array(inverse))
+        result = combined.take(pa.array(inverse))
+        return result.select(columns) if columns is not None else result
 
     def take_batch(self, indices: Sequence[int]) -> pa.RecordBatch:
         return self.take(indices).combine_chunks().to_batches()[0]

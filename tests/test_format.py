@@ -114,3 +114,31 @@ def test_reopen_is_cheap_and_threadsafe(tmp_path):
     [t.start() for t in threads]
     [t.join() for t in threads]
     assert not errs
+
+
+def test_column_projection(image_dataset):
+    """Lance-scanner-style column selection on every read path."""
+    t = image_dataset.read_range(0, 0, 5, columns=["label"])
+    assert t.column_names == ["label"] and t.num_rows == 5
+    batch = next(image_dataset.scan(columns=["label"]))
+    assert batch.schema.names == ["label"]
+    t2 = image_dataset.take([3, 1, 7], columns=["label"])
+    assert t2.column_names == ["label"] and t2.num_rows == 3
+
+
+def test_version_time_travel(tmp_path, image_table):
+    """Dataset(uri, version=N) reads the immutable older snapshot."""
+    from lance_distributed_training_tpu.data import Dataset, write_dataset
+
+    uri = tmp_path / "tt"
+    write_dataset(image_table.slice(0, 50), uri, mode="create",
+                  max_rows_per_file=25)
+    write_dataset(image_table.slice(50, 30), uri, mode="append")
+    latest = Dataset(uri)
+    assert latest.version == 2 and latest.count_rows() == 80
+    old = Dataset(uri, version=1)
+    assert old.version == 1 and old.count_rows() == 50
+    import pytest
+
+    with pytest.raises(FileNotFoundError, match="version 9"):
+        Dataset(uri, version=9)
