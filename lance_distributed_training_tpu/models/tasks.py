@@ -53,13 +53,25 @@ _RESNETS = {
 }
 
 
+def _classifiers() -> dict:
+    from . import vit as _vit
+
+    return {
+        **_RESNETS,
+        "vit_tiny": _vit.vit_tiny,
+        "vit_small": _vit.vit_small,
+        "vit_base": _vit.vit_base,
+    }
+
+
 def _classification_task(num_classes: int, model_name: str, image_size: int,
                          augment: bool) -> Task:
+    registry = _classifiers()
     try:
-        model = _RESNETS[model_name](num_classes=num_classes)
+        model = registry[model_name](num_classes=num_classes)
     except KeyError:
         raise ValueError(
-            f"Invalid model name: {model_name} (have {sorted(_RESNETS)})"
+            f"Invalid model name: {model_name} (have {sorted(registry)})"
         ) from None
 
     def init_variables(rng):

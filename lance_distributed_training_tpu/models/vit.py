@@ -1,0 +1,82 @@
+"""Vision Transformer — the second classification family in the zoo.
+
+The reference's zoo is a single torchvision ResNet-50
+(``/root/reference/modelling/classification.py:6-10``); ViT is the natural
+TPU-first addition: the whole forward is patch-embedding + encoder matmuls
+(pure MXU work, no conv-specific layout concerns), and it reuses
+:class:`.transformer.EncoderBlock` — so tensor-parallel partition rules,
+remat, and the alternative attention backends apply to it unchanged.
+
+Classification head: mean-pooled tokens → LayerNorm → Dense (the simple
+pooling variant; no CLS token so sequence length stays a clean patch grid).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .transformer import EncoderBlock
+
+__all__ = ["ViT", "vit_tiny", "vit_small", "vit_base"]
+
+
+class ViT(nn.Module):
+    """``__call__(images_f32_nhwc, train) -> logits [B, num_classes]``."""
+
+    num_classes: int
+    patch_size: int = 16
+    hidden_size: int = 384
+    num_layers: int = 12
+    num_heads: int = 6
+    mlp_dim: int = 1536
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b, h, w, c = x.shape
+        if h % self.patch_size or w % self.patch_size:
+            raise ValueError(
+                f"image {h}x{w} not divisible by patch {self.patch_size}"
+            )
+        # Patchify = one strided conv straight onto the MXU.
+        x = nn.Conv(
+            self.hidden_size,
+            (self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="patch_embed",
+        )(x.astype(self.dtype))
+        seq = (h // self.patch_size) * (w // self.patch_size)
+        x = x.reshape(b, seq, self.hidden_size)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (seq, self.hidden_size), jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+
+        block = EncoderBlock
+        if self.remat:
+            block = nn.remat(EncoderBlock, static_argnums=())
+        for i in range(self.num_layers):
+            x = block(self.num_heads, self.mlp_dim, self.dtype,
+                      attention_fn=self.attention_fn, name=f"layer_{i}")(x)
+        x = x.mean(axis=1)  # token mean-pool
+        x = nn.LayerNorm(dtype=jnp.float32, param_dtype=jnp.float32,
+                         name="ln_final")(x.astype(jnp.float32))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="head")(x)
+
+
+vit_tiny = partial(ViT, hidden_size=64, num_layers=2, num_heads=2,
+                   mlp_dim=128, patch_size=8)
+vit_small = partial(ViT, hidden_size=384, num_layers=12, num_heads=6,
+                    mlp_dim=1536)
+vit_base = partial(ViT, hidden_size=768, num_layers=12, num_heads=12,
+                   mlp_dim=3072)

@@ -84,11 +84,16 @@ PIPELINE_RULES: Tuple[Tuple[str, P], ...] = (
 )
 
 
-def rules_for_task(task_name: str) -> Tuple[Tuple[str, P], ...]:
-    """Default partition rules per task family."""
+def rules_for_task(
+    task_name: str, model_name: Optional[str] = None
+) -> Tuple[Tuple[str, P], ...]:
+    """Default partition rules per task family (and, for classification,
+    per model family: ViT layers are transformer blocks, ResNets are DP)."""
     if task_name == "masked_lm_pp":
         return PIPELINE_RULES
     if task_name in ("masked_lm", "contrastive"):
+        return TRANSFORMER_RULES
+    if task_name == "classification" and (model_name or "").startswith("vit"):
         return TRANSFORMER_RULES
     return RESNET_RULES
 

@@ -433,7 +433,7 @@ def train(config: TrainConfig) -> dict:
     from .parallel.sharding import batch_partition_spec, rules_for_task
 
     rules = (
-        rules_for_task(task.name)
+        rules_for_task(task.name, config.model_name)
         if (config.model_parallelism > 1 or config.pipeline_parallelism > 1)
         else ()
     )

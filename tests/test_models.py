@@ -96,3 +96,45 @@ def test_clip_contrastive_loss_identity_alignment():
     loss_mismatched = clip_contrastive_loss(emb, perm, 20.0)
     assert float(loss_aligned) < 0.01
     assert float(loss_mismatched) > 1.0
+
+
+def test_vit_classification_task(image_dataset):
+    """ViT joins the classification zoo: end-to-end train() on a tp=2 mesh
+    with transformer partition rules applying to its encoder blocks."""
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    results = train(TrainConfig(
+        dataset_path=image_dataset.uri, num_classes=10, model_name="vit_tiny",
+        image_size=32, batch_size=16, epochs=1, model_parallelism=2,
+        no_wandb=True, eval_at_end=False,
+    ))
+    assert np.isfinite(results["loss"])
+
+
+def test_vit_rules_and_rejects_bad_patch():
+    import jax
+    import pytest
+    from jax.sharding import PartitionSpec as P
+
+    from lance_distributed_training_tpu.models import get_task, vit_tiny
+    from lance_distributed_training_tpu.parallel import get_mesh
+    from lance_distributed_training_tpu.parallel.sharding import (
+        TRANSFORMER_RULES,
+        partition_specs,
+        rules_for_task,
+    )
+
+    assert rules_for_task("classification", "vit_tiny") == TRANSFORMER_RULES
+    assert rules_for_task("classification", "resnet50") == ()
+
+    task = get_task("classification", num_classes=10, model_name="vit_tiny",
+                    image_size=32)
+    mesh = get_mesh(model_parallelism=2)
+    variables = jax.eval_shape(task.init_variables, jax.random.key(0))
+    specs = partition_specs(variables["params"], TRANSFORMER_RULES, mesh)
+    assert specs["layer_0"]["mlp_in"]["kernel"] == P(None, "model")
+    assert specs["patch_embed"]["kernel"] == P()
+
+    model = vit_tiny(num_classes=10)
+    with pytest.raises(ValueError, match="not divisible by patch"):
+        model.init(jax.random.key(0), jnp.zeros((1, 30, 30, 3)), train=False)
