@@ -1,0 +1,146 @@
+"""Shared fail-safe JAX backend init for bench.py and bench_suite.py.
+
+The tunneled TPU backend on this class of box can be transiently
+UNAVAILABLE (another process briefly holds the single chip grant). A failed
+``jax.devices()`` also poisons JAX's in-process backend cache, so the only
+reliable retry is a clean re-exec of the whole script — which additionally
+cannot leave a half-claimed grant behind. Rules encoded here:
+
+- Honor an explicit ``JAX_PLATFORMS`` env choice by pinning
+  ``jax.config jax_platforms`` — the axon plugin's sitecustomize
+  force-updates it to "axon,cpu" at interpreter start, overriding the env
+  var. An explicitly empty ``JAX_PLATFORMS=""`` restores automatic backend
+  selection (the escape hatch JAX's own error message suggests).
+- Retry ONLY errors that look transient (UNAVAILABLE / grant / connection /
+  deadline). Permanent errors ("no device found", bad platform name) fail
+  fast with the structured record instead of burning minutes of backoff.
+- Accumulate the per-attempt error history across re-execs (env var) so the
+  final error record shows every attempt, not just the last.
+- stdout always ends up with exactly one JSON line; progress goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_T0 = time.perf_counter()
+
+_ATTEMPT_ENV = "_BENCH_ATTEMPT"
+_ERRLOG_ENV = "_BENCH_ERROR_LOG"
+_SEP = " ||| "
+
+# Substrings (lowercased) that mark a backend-init error as retryable.
+TRANSIENT_MARKERS = (
+    "unavailable",
+    "grant",
+    "deadline",
+    "timed out",
+    "timeout",
+    "connection",
+    "resource exhausted",
+    "resource_exhausted",
+    "temporarily",
+    "try again",
+)
+
+
+def log(msg: str) -> None:
+    """Phase progress to stderr; stdout carries only the final JSON line."""
+    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except (TypeError, ValueError):
+        log(f"ignoring unparseable env {name}={os.environ.get(name)!r}")
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except (TypeError, ValueError):
+        log(f"ignoring unparseable env {name}={os.environ.get(name)!r}")
+        return default
+
+
+def emit_error(metric: str, stage: str, error: str, attempts: int,
+               history: list[str] | None = None) -> None:
+    """Final-failure path: one structured JSON line on stdout, then rc=1."""
+    record = {
+        "metric": metric,
+        "value": None,
+        "unit": None,
+        "vs_baseline": None,
+        "error": {
+            "stage": stage,
+            "backend": os.environ.get("JAX_PLATFORMS", "auto"),
+            "attempts": attempts,
+            "last_error": error[:2000],
+        },
+    }
+    if history:
+        record["error"]["history"] = history
+    print(json.dumps(record), flush=True)
+    sys.exit(1)
+
+
+def init_devices(metric: str):
+    """Claim accelerator devices; returns ``(jax_module, devices)``.
+
+    On a transient failure, sleeps with exponential backoff and re-execs
+    this process (incrementing an attempt counter carried in the
+    environment). On a permanent failure or attempt exhaustion, emits the
+    structured error JSON line and exits 1. May legitimately BLOCK for a
+    long time inside ``jax.devices()`` while queued behind an expiring
+    grant — callers/operators must not wrap this in ``timeout``.
+    """
+    attempt = env_int(_ATTEMPT_ENV, 1)
+    max_attempts = env_int("BENCH_MAX_ATTEMPTS", 5)
+    backoff_base = env_float("BENCH_BACKOFF_BASE", 15.0)
+
+    import jax
+
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms is not None:
+        try:
+            jax.config.update("jax_platforms", env_platforms or None)
+        except Exception as e:  # noqa: BLE001
+            log(f"could not pin jax_platforms={env_platforms!r}: {e}")
+
+    log(f"backend init attempt {attempt}/{max_attempts} (jax {jax.__version__}, "
+        f"JAX_PLATFORMS={'<unset>' if env_platforms is None else env_platforms!r})")
+    try:
+        devices = jax.devices()
+    except Exception as e:  # noqa: BLE001 — classified below
+        err = f"{type(e).__name__}: {e}"
+        log(f"backend init FAILED: {err}")
+        history = [h for h in os.environ.get(_ERRLOG_ENV, "").split(_SEP) if h]
+        history.append(f"attempt {attempt}: {err[:300]}")
+        lowered = err.lower()
+        if not any(m in lowered for m in TRANSIENT_MARKERS):
+            log("error looks permanent — not retrying")
+            emit_error(metric, "backend_init", err, attempt, history)
+        if attempt >= max_attempts:
+            emit_error(metric, "backend_init", err, attempt, history)
+        delay = min(300.0, backoff_base * (2 ** (attempt - 1)))
+        log(f"sleeping {delay:.0f}s then re-exec (attempt {attempt + 1})")
+        time.sleep(delay)
+        env = dict(os.environ)
+        env[_ATTEMPT_ENV] = str(attempt + 1)
+        env[_ERRLOG_ENV] = _SEP.join(history)[-4000:]
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(sys.argv[0])] + sys.argv[1:],
+                  env)
+    log(f"devices: {devices}")
+    return jax, devices
+
+
+def init_attempts() -> int:
+    """How many backend-init attempts this process chain has made."""
+    return env_int(_ATTEMPT_ENV, 1)

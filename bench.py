@@ -11,6 +11,19 @@ per-rank batch 128 (300 it ≈ 37875 rows/rank per epoch on FOOD101;
 /root/reference/README.md:164-184 and lance_map_style.py:134) ⇒ ≈87.7
 images/sec per GPU.
 
+Backend-init robustness (retry/backoff via clean re-exec, transient-error
+classification, structured error JSON) lives in ``_bench_init.py``, shared
+with ``bench_suite.py``. Every later stage is wrapped too, so stdout ALWAYS
+carries exactly one JSON line: a result on success, an error record on
+failure.
+
+Env knobs:
+    BENCH_BATCH         per-chip batch size (default 128)
+    BENCH_STEPS         measured steps (default 10)
+    BENCH_MAX_ATTEMPTS  backend-init attempts before giving up (default 5)
+    BENCH_BACKOFF_BASE  first retry delay in seconds (default 15)
+    BENCH_TRACE=1       capture a jax.profiler trace of the measured window
+
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
 """
@@ -24,14 +37,9 @@ import time
 
 import numpy as np
 
+from _bench_init import emit_error, env_int, init_attempts, init_devices, log
 
-def _log(msg: str) -> None:
-    """Phase progress to stderr; stdout carries only the final JSON line."""
-    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}",
-          file=sys.stderr, flush=True)
-
-
-_T0 = time.perf_counter()
+METRIC = "food101_resnet50_images_per_sec_per_chip"
 
 REFERENCE_IMAGES_PER_SEC_PER_CHIP = 87.7  # README.md:164-184, batch 128 / 1.46 s
 
@@ -62,9 +70,7 @@ def make_synthetic_food101(uri: str, rows: int, image_size: int = 224) -> None:
     write_dataset(table, uri, mode="overwrite", max_rows_per_file=rows // 4)
 
 
-def main() -> None:
-    import jax
-
+def _run(jax, devices) -> dict:
     # Persistent compile cache: the ResNet-50 train step is a multi-minute
     # first compile on the tunneled TPU; cache it across bench runs.
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
@@ -92,18 +98,19 @@ def main() -> None:
     )
     from lance_distributed_training_tpu.utils.metrics import StepTimer
 
-    n_chips = len(jax.devices())
-    _log(f"devices: {jax.devices()}")
-    batch_size = int(os.environ.get("BENCH_BATCH", 128)) * n_chips
+    n_chips = len(devices)
+    platform = devices[0].platform
+    batch_size = env_int("BENCH_BATCH", 128) * n_chips
     image_size = 224
-    warmup, measure = 2, 10
+    warmup = 2
+    measure = env_int("BENCH_STEPS", 10)
     rows = batch_size * (warmup + measure)
 
     tmp = tempfile.mkdtemp(prefix="ldt-bench-")
     uri = os.path.join(tmp, "food101")
     make_synthetic_food101(uri, rows, image_size)
     dataset = Dataset(uri)
-    _log(f"dataset ready: {rows} rows")
+    log(f"dataset ready: {rows} rows")
 
     mesh = get_mesh()
     task = get_task("classification", num_classes=101, model_name="resnet50",
@@ -112,13 +119,16 @@ def main() -> None:
     state = create_train_state(jax.random.key(0), task, cfg)
     state = jax.device_put(state, replicated_sharding(mesh))
     step = make_train_step(task, mesh)
-    _log("model state initialised")
+    log("model state initialised")
 
     decode = ImageClassificationDecoder(image_size=image_size)
     pipe = make_train_pipeline(
         dataset, "batch", batch_size, 0, 1, decode,
         device_put_fn=lambda b: make_global_batch(b, mesh), prefetch=3,
     )
+
+    trace = os.environ.get("BENCH_TRACE", "") == "1"
+    trace_dir = os.path.join(tmp, "trace")
 
     rng = jax.random.key(1)
     timer = StepTimer()
@@ -135,28 +145,50 @@ def main() -> None:
             jax.block_until_ready(loss)  # absorb compile into warmup
         timer.step_stop()
         if i < warmup:
-            _log(f"warmup step {i} done")
+            log(f"warmup step {i} done")
         if i == warmup - 1:
             timer.reset()
             t0 = time.perf_counter()
+            if trace:
+                jax.profiler.start_trace(trace_dir)
     jax.block_until_ready(loss)
     wall = time.perf_counter() - t0
+    if trace:
+        jax.profiler.stop_trace()
+        log(f"profiler trace written to {trace_dir}")
     images_per_sec = measure * batch_size / wall
     per_chip = images_per_sec / n_chips
 
-    print(
-        json.dumps(
-            {
-                "metric": "food101_resnet50_images_per_sec_per_chip",
-                "value": round(per_chip, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3),
-                "loader_stall_pct": round(timer.loader_stall_pct, 2),
-                "chips": n_chips,
-                "global_batch": batch_size,
-            }
-        )
-    )
+    result = {
+        "metric": METRIC,
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3),
+        "loader_stall_pct": round(timer.loader_stall_pct, 2),
+        "chips": n_chips,
+        "global_batch": batch_size,
+        "platform": platform,
+        "measured_steps": measure,
+        "wall_s": round(wall, 3),
+    }
+    if trace:
+        result["trace_dir"] = trace_dir
+    return result
+
+
+def main() -> None:
+    jax, devices = init_devices(METRIC)
+    attempts = init_attempts()
+    try:
+        result = _run(jax, devices)
+    except Exception as e:  # noqa: BLE001 — always leave a parseable line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        emit_error(METRIC, "run", f"{type(e).__name__}: {e}", attempts)
+        return
+    if attempts > 1:
+        result["backend_init_attempts"] = attempts
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

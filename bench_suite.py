@@ -57,12 +57,6 @@ def _force_cpu(n_devices: int = 1) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def _on_accelerator() -> bool:
-    import jax
-
-    return jax.devices()[0].platform != "cpu"
-
-
 def _train_metrics(cfg, steps_hint: int) -> dict:
     """Run train() for 2 epochs; epoch 1 (post-compile) is the measurement."""
     from lance_distributed_training_tpu.trainer import train
@@ -77,12 +71,22 @@ def _train_metrics(cfg, steps_hint: int) -> dict:
 
 
 def run_config(name: str) -> dict:
+    from _bench_init import init_devices
+
     from lance_distributed_training_tpu.trainer import TrainConfig
 
     # BENCH_BACKEND=cpu pins the whole suite to CPU (smoke runs, or a box
     # whose TPU tunnel is busy); BENCH_CPU_DEVICES simulates a mesh.
     if os.environ.get("BENCH_BACKEND") == "cpu":
         _force_cpu(int(os.environ.get("BENCH_CPU_DEVICES") or 1))
+    if name == "food101-resnet18-map":
+        # "single-process CPU" by definition — pin BEFORE the backend claim
+        # so this config never touches (or waits on) the TPU tunnel.
+        _force_cpu(1)
+
+    # Shared robust claim: retries transient UNAVAILABLE with backoff via
+    # re-exec, fails fast (structured JSON, rc=1) on permanent errors.
+    _jax, devices = init_devices(metric=name)
 
     tmp = tempfile.mkdtemp(prefix=f"ldt-suite-{name}-")
     uri = os.path.join(tmp, "ds")
@@ -90,8 +94,7 @@ def run_config(name: str) -> dict:
 
     if name == "food101-resnet18-map":
         # "FOOD101 ResNet-18 map-style (single-process CPU)" — CPU by
-        # definition, one device (the reference's --no_ddp smoke config).
-        _force_cpu(1)
+        # definition, one device (pinned above, before the backend claim).
         from lance_distributed_training_tpu.data import (
             create_synthetic_classification_dataset,
         )
@@ -119,13 +122,12 @@ def run_config(name: str) -> dict:
         from lance_distributed_training_tpu.data import (
             create_synthetic_classification_dataset,
         )
-        import jax
 
         imagenet = name == "imagenet-fragment"
-        accel = _on_accelerator()
+        accel = devices[0].platform != "cpu"
         model = "resnet50" if accel else "resnet18"
         per_chip = 16 if SMALL else (128 if accel else 32)
-        batch = per_chip * len(jax.devices())
+        batch = per_chip * len(devices)
         steps = 3 if SMALL else 8
         size = 96 if SMALL else 224
         rows = batch * steps
@@ -156,14 +158,13 @@ def run_config(name: str) -> dict:
         from lance_distributed_training_tpu.data import (
             create_text_token_dataset,
         )
-        import jax
 
-        accel = _on_accelerator()
+        accel = devices[0].platform != "cpu"
         model = "bert_base" if accel else "bert_small"
         vocab = 30522 if accel else 2048
         seq_len = 32 if SMALL else 128
         per_chip = 8 if SMALL else (64 if accel else 16)
-        batch = per_chip * len(jax.devices())
+        batch = per_chip * len(devices)
         steps = 3 if SMALL else 8
         rows = batch * steps
         gen = np.random.default_rng(0)
@@ -188,14 +189,13 @@ def run_config(name: str) -> dict:
         from lance_distributed_training_tpu.data import (
             create_synthetic_image_text_dataset,
         )
-        import jax
 
-        accel = _on_accelerator()
+        accel = devices[0].platform != "cpu"
         model = "clip_resnet50_bert" if accel else "clip_tiny"
         seq_len = 16
         size = 224 if accel and not SMALL else 64
         per_chip = 8 if SMALL else (64 if accel else 16)
-        batch = per_chip * len(jax.devices())
+        batch = per_chip * len(devices)
         steps = 3 if SMALL else 6
         rows = batch * steps
         create_synthetic_image_text_dataset(
@@ -226,9 +226,18 @@ def run_config(name: str) -> dict:
 def main() -> None:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     if "--run" in sys.argv:
-        # Child mode: run one config in THIS process, print its JSON line.
+        # Child mode: run one config in THIS process, print its JSON line —
+        # a structured error line if anything past backend init blows up.
         name = sys.argv[sys.argv.index("--run") + 1]
-        print(json.dumps(run_config(name)), flush=True)
+        try:
+            print(json.dumps(run_config(name)), flush=True)
+        except Exception as e:  # noqa: BLE001 — always leave a parseable line
+            import traceback
+
+            from _bench_init import emit_error, init_attempts
+
+            traceback.print_exc(file=sys.stderr)
+            emit_error(name, "run", f"{type(e).__name__}: {e}", init_attempts())
         return
     names = args or CONFIG_NAMES
     for name in names:
@@ -238,13 +247,15 @@ def main() -> None:
             [sys.executable, os.path.abspath(__file__), "--run", name],
             capture_output=True, text=True,
         )
+        # Prefer the child's own JSON line (success OR structured error);
+        # synthesize one only if the child died without printing any.
         lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
-        if proc.returncode != 0 or not lines:
+        if lines:
+            print(lines[-1], flush=True)
+        else:
             print(json.dumps({"metric": name, "error":
                               (proc.stderr or "no output").strip()[-400:]}),
                   flush=True)
-            continue
-        print(lines[-1], flush=True)
 
 
 if __name__ == "__main__":
