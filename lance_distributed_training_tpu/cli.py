@@ -51,10 +51,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="token vocabulary; default = the model's own "
                         "(bert_*: 30522, clip_tiny: 1000)")
     p.add_argument("--prefetch", type=int, default=2)
+    p.add_argument("--producer_threads", type=int, default=2,
+                   help="decode-producer threads (cross-batch overlap)")
+    p.add_argument("--shuffle", action="store_true",
+                   help="iterable path: reshuffle batch order every epoch "
+                        "(same permutation on every process)")
     p.add_argument("--no_augment", action="store_true")
     p.add_argument("--eval_every", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--run_name", type=str, default=None)
+    p.add_argument("--log_every", type=int, default=50,
+                   help="per-step progress line every N steps (0 = off)")
     p.add_argument("--model_parallelism", type=int, default=1,
                    help="tensor-parallel degree (the 'model' mesh axis)")
     p.add_argument("--seq_parallelism", type=int, default=1,
@@ -100,18 +107,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
-    if args.coordinator_address:
-        # Multi-host rendezvous must precede ANY backend query (including the
-        # --backend tpu device probe below).
-        from .parallel.mesh import maybe_initialize_distributed
-
-        maybe_initialize_distributed(
-            args.coordinator_address, args.num_processes, args.process_id
-        )
     if args.backend == "cpu":
         import jax
 
-        # Must run before the first backend query. Overrides the platform
+        # Platform config must run before the first backend query (and before
+        # rendezvous, which may query local devices). Overrides the platform
         # even where a plugin (e.g. the axon TPU tunnel) has pinned
         # jax_platforms over the JAX_PLATFORMS env var. --backend tpu is the
         # default on TPU environments, so only "cpu" needs forcing.
@@ -123,7 +123,17 @@ def main(argv=None) -> dict:
                     f"--num_cpu_devices must be set before JAX initializes: {e}"
                 )
         jax.config.update("jax_platforms", "cpu")
-    elif args.backend == "tpu":
+    # Multi-host rendezvous must precede ANY backend query, including the
+    # --backend tpu device probe below. Unconditional: with no explicit
+    # --coordinator_address it still honours JAX_COORDINATOR_ADDRESS from the
+    # environment (torchrun's env-first contract,
+    # /root/reference/lance_iterable.py:154-156); no-op when single-process.
+    from .parallel.mesh import maybe_initialize_distributed
+
+    maybe_initialize_distributed(
+        args.coordinator_address, args.num_processes, args.process_id
+    )
+    if args.backend == "tpu":
         import jax
 
         # Don't force a platform string (TPU plugins register under varying
@@ -156,10 +166,13 @@ def main(argv=None) -> dict:
         seq_len=args.seq_len,
         vocab_size=args.vocab_size,
         prefetch=args.prefetch,
+        producer_threads=args.producer_threads,
+        shuffle=args.shuffle,
         augment=not args.no_augment,
         eval_every=args.eval_every,
         seed=args.seed,
         run_name=args.run_name,
+        log_every=args.log_every,
         model_parallelism=args.model_parallelism,
         seq_parallelism=args.seq_parallelism,
         remat=args.remat,

@@ -46,6 +46,7 @@ class FolderDataPipeline:
         drop_last: bool = True,
         prefetch: int = 2,
         workers=None,
+        producers: int = 1,
     ):
         self.samples, self.classes = _folder_samples(root)
         if not self.samples:
@@ -61,6 +62,7 @@ class FolderDataPipeline:
         self.drop_last = drop_last
         self.prefetch = prefetch
         self.workers = workers
+        self.producers = producers
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -109,5 +111,6 @@ class FolderDataPipeline:
             prefetch=self.prefetch,
             read_fn=lambda _ds, idx: self._read(idx),
             workers=self.workers,
+            producers=self.producers,
         )
         return iter(pipe)

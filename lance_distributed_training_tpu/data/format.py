@@ -89,6 +89,7 @@ class _FragmentReader:
             for i in range(self._reader.num_record_batches)
         ]
         self._offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        self._table: Optional[pa.Table] = None  # lazy take() cache
 
     @property
     def num_rows(self) -> int:
@@ -118,11 +119,18 @@ class _FragmentReader:
 
     def take(self, indices: Sequence[int]) -> pa.Table:
         """Random-access rows by fragment-local index (preserves order)."""
-        table = pa.Table.from_batches(
-            [self._reader.get_batch(i) for i in range(self._reader.num_record_batches)],
-            schema=self._reader.schema,
-        )
-        return table.take(pa.array(np.asarray(indices, dtype=np.int64)))
+        if self._table is None:
+            # Assemble once per reader: the batches are zero-copy views into
+            # the memory map, so this caches only metadata — rebuilding it per
+            # take() call cost per-batch metadata work every map-style step.
+            self._table = pa.Table.from_batches(
+                [
+                    self._reader.get_batch(i)
+                    for i in range(self._reader.num_record_batches)
+                ],
+                schema=self._reader.schema,
+            )
+        return self._table.take(pa.array(np.asarray(indices, dtype=np.int64)))
 
 
 class Dataset:

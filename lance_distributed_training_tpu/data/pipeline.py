@@ -65,6 +65,12 @@ class DataPipeline:
     device_put_fn: host batch dict → device batch (a closure over
         ``make_global_batch(mesh)``); ``None`` yields host numpy batches.
     prefetch: queue depth of decoded batches kept ahead of the consumer.
+    producers: number of producer threads decoding plan items concurrently
+        (results still yielded in plan order). With one producer there is no
+        decode overlap *across* batches: the serial per-batch work (Arrow
+        range read, label conversion, output-buffer faulting) gates the
+        native decoder's thread pool. Two producers keep the pool saturated
+        while the other thread runs the serial sections.
     workers: optional :class:`~.workers.WorkerPool` — read+decode runs in N
         worker processes instead of the producer thread (the reference's
         ``get_safe_loader``/``num_workers`` path,
@@ -80,6 +86,7 @@ class DataPipeline:
         prefetch: int = 2,
         read_fn: Callable[[Dataset, object], pa.Table] = _range_read,
         workers=None,
+        producers: int = 1,
     ):
         self.dataset = dataset
         self.plan = list(plan)
@@ -88,6 +95,7 @@ class DataPipeline:
         self.prefetch = max(1, prefetch)
         self.read_fn = read_fn
         self.workers = workers
+        self.producers = max(1, producers)
 
     def __len__(self) -> int:
         return len(self.plan)
@@ -109,6 +117,9 @@ class DataPipeline:
             q.put(exc)
 
     def __iter__(self) -> Iterator[dict]:
+        if self.workers is None and self.producers > 1:
+            yield from self._iter_multi_producer()
+            return
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
         producer = threading.Thread(
@@ -136,6 +147,69 @@ class DataPipeline:
                 except queue.Empty:
                     producer.join(timeout=0.1)
 
+    def _iter_multi_producer(self) -> Iterator[dict]:
+        """Ordered fan-out: ``producers`` daemon threads decode concurrently,
+        thread ``k`` handling plan items ``k, k+N, …`` into its own bounded
+        queue; the consumer round-robins the queues, so batches come out in
+        plan order (sharded global-batch assembly stays deterministic) with
+        total buffered depth ≈ ``max(prefetch, producers)``. Daemon threads +
+        the drain in ``finally`` mean a hung decode can never block
+        interpreter exit (plain ``ThreadPoolExecutor`` workers would — its
+        atexit hook joins them)."""
+        n = self.producers
+        per = max(1, -(-max(self.prefetch, n) // n))
+        queues = [queue.Queue(maxsize=per) for _ in range(n)]
+        stop = threading.Event()
+
+        def produce(k: int) -> None:
+            try:
+                for item in self.plan[k::n]:
+                    if stop.is_set():
+                        return
+                    queues[k].put(self.decode_fn(self.read_fn(self.dataset, item)))
+                queues[k].put(_SENTINEL)
+            except BaseException as exc:  # surface errors to the consumer
+                queues[k].put(exc)
+
+        threads = [
+            threading.Thread(
+                target=produce, args=(k,), daemon=True, name=f"ldt-producer-{k}"
+            )
+            for k in range(n)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            active = [True] * n
+            done = 0
+            i = 0
+            while done < n:
+                k = i % n
+                i += 1
+                if not active[k]:
+                    continue
+                item = queues[k].get()
+                if item is _SENTINEL:
+                    active[k] = False
+                    done += 1
+                    continue
+                if isinstance(item, BaseException):
+                    raise item
+                if self.device_put_fn is not None:
+                    item = self.device_put_fn(item)
+                yield item
+        finally:
+            stop.set()
+            # Drain so blocked put()s can observe the stop flag.
+            while any(t.is_alive() for t in threads):
+                for q in queues:
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        pass
+                for t in threads:
+                    t.join(timeout=0.05)
+
 
 def make_train_pipeline(
     dataset: Dataset,
@@ -148,6 +222,10 @@ def make_train_pipeline(
     prefetch: int = 2,
     check_deadlock: bool = True,
     workers=None,
+    producers: int = 1,
+    shuffle: bool = False,
+    seed: int = 0,
+    epoch: int = 0,
 ) -> DataPipeline:
     """Iterable-style pipeline — parity with ``get_sampler``+``get_dataset``+
     ``get_loader`` (``/root/reference/lance_iterable.py:53-72,86-88``).
@@ -159,17 +237,31 @@ def make_train_pipeline(
     documented fragment-imbalance deadlock (``README.md:140-157``).
     """
     rows = dataset.fragment_rows()
+    if sampler_type in ("full", "full_scan") and process_count > 1:
+        # The reference documents FullScanSampler as "not DP-aware" —
+        # single-device eval/debug only (/root/reference/README.md:126,
+        # 130-138). Multi-process, each process's identical full scan would
+        # be stitched into a bogus "global" batch of duplicated rows; refuse
+        # instead of silently training on duplicates.
+        raise ValueError(
+            "sampler_type='full' is not DP-aware (every process scans the "
+            f"whole dataset) and cannot run across {process_count} processes; "
+            "use sampler_type='batch' or 'fragment', or launch a single "
+            "process (no coordinator/multi-host env) for eval/debug"
+        )
     if check_deadlock and sampler_type not in ("full", "full_scan"):
         plans = [
-            make_plan(sampler_type, rows, batch_size, p, process_count)
+            make_plan(sampler_type, rows, batch_size, p, process_count,
+                      shuffle=shuffle, seed=seed, epoch=epoch)
             for p in range(process_count)
         ]
         assert_equal_step_counts(plans, batch_size)
         plan: Plan = plans[process_index]
     else:
-        plan = make_plan(sampler_type, rows, batch_size, process_index, process_count)
+        plan = make_plan(sampler_type, rows, batch_size, process_index,
+                         process_count, shuffle=shuffle, seed=seed, epoch=epoch)
     return DataPipeline(dataset, plan, decode_fn, device_put_fn, prefetch,
-                        workers=workers)
+                        workers=workers, producers=producers)
 
 
 class MapStylePipeline:
@@ -196,6 +288,7 @@ class MapStylePipeline:
         drop_last: bool = True,
         prefetch: int = 2,
         workers=None,
+        producers: int = 1,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -209,6 +302,7 @@ class MapStylePipeline:
         self.drop_last = drop_last
         self.prefetch = prefetch
         self.workers = workers
+        self.producers = producers
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -238,6 +332,7 @@ class MapStylePipeline:
                 self.prefetch,
                 read_fn=_take_read,
                 workers=self.workers,
+                producers=self.producers,
             )
         )
 

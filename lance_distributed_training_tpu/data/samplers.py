@@ -101,6 +101,10 @@ def sharded_batch_plan(
     batch_size: int,
     process_index: int,
     process_count: int,
+    *,
+    shuffle: bool = False,
+    seed: int = 0,
+    epoch: int = 0,
 ) -> Plan:
     """Batch-level round-robin sharding — balanced by construction.
 
@@ -111,15 +115,27 @@ def sharded_batch_plan(
 
     The trailing partial global batch and the trailing un-deal-able full
     batches are dropped so every process gets exactly the same step count.
+
+    ``shuffle=True`` goes beyond the reference (Lance samplers are
+    deterministic every epoch — no ``set_epoch`` anywhere in
+    ``lance_iterable.py``): the *batch order* is permuted with a
+    ``seed + epoch``-seeded RNG. Every process draws the identical
+    permutation, so batches stay disjoint and step counts stay equal (the
+    deadlock invariant); rows within a batch keep their storage order, so
+    reads remain contiguous ranges.
     """
     _check_topology(process_index, process_count)
     total = int(sum(fragment_rows))
     num_batches = total // batch_size  # drop ragged tail
     usable = (num_batches // process_count) * process_count
+    order = np.arange(usable)
+    if shuffle:
+        order = np.random.default_rng(seed + epoch).permutation(usable)
     plan: Plan = []
-    for b in range(process_index, usable, process_count):
+    for b in order[process_index::process_count]:
         plan.append(
-            _global_to_ranges(fragment_rows, b * batch_size, (b + 1) * batch_size)
+            _global_to_ranges(fragment_rows, int(b) * batch_size,
+                              (int(b) + 1) * batch_size)
         )
     return plan
 
@@ -269,12 +285,25 @@ def make_plan(
     process_count: int,
     *,
     pad: bool = True,
+    shuffle: bool = False,
+    seed: int = 0,
+    epoch: int = 0,
 ) -> Plan:
     """Dispatch by name — parity with ``get_sampler``'s string dispatch
-    (``/root/reference/lance_iterable.py:61-69``)."""
+    (``/root/reference/lance_iterable.py:61-69``). ``shuffle`` applies to the
+    batch sampler only (epoch batch-order reshuffle, identical on every
+    process); requesting it with another sampler raises rather than silently
+    replaying the same order every epoch."""
+    if shuffle and sampler_type not in ("batch", "sharded_batch"):
+        raise ValueError(
+            f"shuffle=True supports sampler_type='batch' only (fragment "
+            f"plans read whole fragments sequentially; full scans are "
+            f"eval-only) — got {sampler_type!r}"
+        )
     if sampler_type in ("batch", "sharded_batch"):
         return sharded_batch_plan(
-            fragment_rows, batch_size, process_index, process_count
+            fragment_rows, batch_size, process_index, process_count,
+            shuffle=shuffle, seed=seed, epoch=epoch,
         )
     if sampler_type in ("fragment", "sharded_fragment"):
         return sharded_fragment_plan(

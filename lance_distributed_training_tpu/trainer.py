@@ -91,6 +91,10 @@ class TrainConfig:
     seq_len: int = 128  # masked_lm / contrastive text length
     vocab_size: Optional[int] = None  # None = the model's own default
     prefetch: int = 2
+    producer_threads: int = 2  # decode-producer threads (cross-batch overlap)
+    shuffle: bool = False  # iterable path: epoch batch-order reshuffle
+    # (beyond the reference — Lance samplers replay the same order every
+    # epoch; map-style shuffles regardless, as DistributedSampler does)
     augment: bool = True
     eval_at_end: bool = True  # rank-0 eval over train loader (lance_iterable.py:125-127)
     eval_every: int = 0  # map-style: val every N epochs (lance_map_style.py:109-112)
@@ -284,14 +288,25 @@ def make_eval_step(task: Task, mesh, *, state_sharding=None, batch_spec=None):
 
 def evaluate(state, loader, eval_step) -> float:
     """Mean per-example metric over a loader — the ``evaluate`` equivalent
-    (``/root/reference/modelling/classification.py:20-32``)."""
-    correct = 0.0
+    (``/root/reference/modelling/classification.py:20-32``). The per-batch
+    sums accumulate ON DEVICE (async dispatch); the only host sync is the
+    final ``float()`` — unlike the reference's per-step ``.item()``
+    (``lance_iterable.py:115``) this never serialises eval on D2H."""
+    correct = None
     total = 0
+    batches = 0
     for batch in loader:
-        correct += float(eval_step(state, batch))
+        part = eval_step(state, batch)
+        correct = part if correct is None else correct + part
         first = jax.tree_util.tree_leaves(batch)[0]
         total += first.shape[0]
-    return correct / total if total else 0.0
+        batches += 1
+        if batches % 32 == 0:
+            # Bound dispatch depth: each in-flight eval step pins its batch
+            # on device; one sync per 32 batches caps that without
+            # serialising every step as the reference's .item() did.
+            jax.block_until_ready(correct)
+    return float(correct) / total if total else 0.0
 
 
 def _decoder_for(config: TrainConfig):
@@ -357,6 +372,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             epoch=epoch,
             prefetch=config.prefetch,
             workers=workers,
+            producers=config.producer_threads,
         )
         if len(loader) == 0:
             raise ValueError("folder smaller than one global batch")
@@ -382,6 +398,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             epoch=epoch,
             prefetch=config.prefetch,
             workers=workers,
+            producers=config.producer_threads,
         )
     else:
         loader = make_train_pipeline(
@@ -394,6 +411,10 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             put,
             prefetch=config.prefetch,
             workers=workers,
+            producers=config.producer_threads,
+            shuffle=config.shuffle,
+            seed=config.seed,
+            epoch=epoch,
         )
     if len(loader) == 0:
         raise ValueError(
@@ -540,11 +561,36 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             timer.step_start()
             state, loss = train_step(state, batch, step_rng)
             loss_sum = loss_sum + loss
-            if (global_step + 1) % config.log_every == 0:
-                jax.block_until_ready(loss)  # bound async queue depth
+            # Bound the async dispatch queue (each in-flight step pins its
+            # global batch on device) — independent of logging, so neither
+            # log_every=0 nor a huge log_every can unbound device memory.
+            sync_every = min(config.log_every or 50, 50)
+            if (global_step + 1) % sync_every == 0:
+                jax.block_until_ready(loss)
             timer.step_stop()
             global_step += 1
             epoch_step += 1
+            if config.log_every and global_step % config.log_every == 0:
+                # Per-step progress — the reference's live tqdm it/s + loss
+                # (lance_iterable.py:106,116-117). Console/JSONL only; wandb
+                # stays on the per-epoch axis. The loss D2H is free: the
+                # block_until_ready above already synced this step.
+                w = timer.window()
+                wt = w["loader_s"] + w["step_s"]
+                logger.log(
+                    {
+                        "step": global_step,
+                        "epoch": epoch,
+                        "loss": round(float(loss), 4),
+                        "images_per_sec": (
+                            config.batch_size * w["steps"] / wt if wt else 0.0
+                        ),
+                        "loader_stall_pct": (
+                            100.0 * w["loader_s"] / wt if wt else 0.0
+                        ),
+                    },
+                    to_wandb=False,
+                )
         if profiling:  # epoch shorter than the trace window
             jax.profiler.stop_trace()
             profiling = False

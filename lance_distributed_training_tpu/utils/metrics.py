@@ -56,13 +56,19 @@ class MetricLogger:
         except OSError:
             self._jsonl = None
 
-    def log(self, metrics: dict, step: Optional[int] = None) -> None:
+    def log(self, metrics: dict, step: Optional[int] = None,
+            to_wandb: bool = True) -> None:
+        """``to_wandb=False`` routes to console/JSONL only — used for
+        per-step progress lines (the reference's tqdm ``set_postfix``,
+        ``lance_iterable.py:106,116-117``) so the wandb step axis stays
+        per-epoch as the reference's ``wandb.log`` is
+        (``lance_iterable.py:122-123``)."""
         if not self.enabled:
             return
         record = dict(metrics)
         if step is not None:
             record["step"] = step
-        if self._wandb is not None:
+        if self._wandb is not None and to_wandb:
             self._wandb.log(metrics, step=step)
         if self._jsonl is not None:
             self._jsonl.write(json.dumps(record) + "\n")
@@ -100,6 +106,22 @@ class StepTimer:
         self.step_s = 0.0
         self.steps = 0
         self._t = 0.0
+        self._w_loader = 0.0
+        self._w_step = 0.0
+        self._w_steps = 0
+
+    def window(self) -> dict:
+        """Deltas since the previous ``window()`` call (or ``reset``) — the
+        per-``log_every`` stats for per-step progress lines."""
+        out = {
+            "steps": self.steps - self._w_steps,
+            "loader_s": self.loader_s - self._w_loader,
+            "step_s": self.step_s - self._w_step,
+        }
+        self._w_loader = self.loader_s
+        self._w_step = self.step_s
+        self._w_steps = self.steps
+        return out
 
     def loader_start(self) -> None:
         self._t = time.perf_counter()

@@ -18,13 +18,15 @@ import jax
 # "axon,cpu" at interpreter start, overriding the env var — pin it back.
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compile cache: the suite's dominant cost is re-jitting the same
-# train steps; cache them across tests and across runs.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# NO persistent compile cache. XLA:CPU's persistent cache stores AOT machine
+# code whose round-trip is unsound for shard_map collective programs: loading
+# a cached ppermute executable (even on the same machine that wrote it) makes
+# one device thread die, the other participants wait at the collective-permute
+# rendezvous, and the 40 s rendezvous watchdog aborts the whole interpreter
+# ("Fatal Python error: Aborted"). Cross-machine it is worse — the cache key
+# omits host CPU features, so a cache written elsewhere poisons every heavy
+# test. Within one pytest process jit's in-memory cache already dedups
+# compiles, so persistence bought little; correctness wins.
 
 import io
 import sys

@@ -102,3 +102,89 @@ def test_text_token_dataset_pad_mode(tmp_path):
     assert out["input_ids"][0].tolist() == [1, 2, 3, 0, 0, 0, 0, 0]
     assert out["attention_mask"][0].tolist() == [1, 1, 1, 0, 0, 0, 0, 0]
     assert out["input_ids"][1].tolist() == [4, 5, 6, 7, 8, 9, 10, 11]  # truncated
+
+
+@pytest.fixture()
+def food101_tree(tmp_path):
+    """Minimal food-101 layout: meta/{classes,train,test}.txt + images/."""
+    from PIL import Image
+
+    rng = np.random.default_rng(1)
+    root = tmp_path / "food-101"
+    (root / "meta").mkdir(parents=True)
+    classes = ["apple_pie", "baby_back_ribs"]
+    train, test = [], []
+    for cls in classes:
+        d = root / "images" / cls
+        d.mkdir(parents=True)
+        for i in range(6):
+            arr = (rng.random((40, 40, 3)) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"{1000 + i}.jpg", quality=90)
+            (train if i < 4 else test).append(f"{cls}/{1000 + i}")
+    (root / "meta" / "classes.txt").write_text("\n".join(classes) + "\n")
+    (root / "meta" / "train.txt").write_text("\n".join(train) + "\n")
+    (root / "meta" / "test.txt").write_text("\n".join(test) + "\n")
+    return str(root)
+
+
+def test_food101_recipe_from_tree(food101_tree, tmp_path):
+    from lance_distributed_training_tpu.data import create_food101_datasets
+
+    train_ds, test_ds = create_food101_datasets(
+        food101_tree, str(tmp_path / "out"), fragment_size=5
+    )
+    assert train_ds.count_rows() == 8 and test_ds.count_rows() == 4
+    assert len(train_ds.get_fragments()) == 2  # 8 rows / fragment_size 5
+    # Labels follow sorted classes.txt (torchvision Food101 convention);
+    # images pass through byte-identical (no re-encode).
+    labels = train_ds.take(list(range(8))).column("label").to_pylist()
+    assert sorted(set(labels)) == [0, 1]
+    payload = train_ds.take([0]).column("image")[0].as_py()
+    assert payload[:2] == b"\xff\xd8"  # JPEG magic
+
+
+def test_food101_recipe_from_tarball(food101_tree, tmp_path):
+    import tarfile
+
+    from lance_distributed_training_tpu.data import create_food101_datasets
+
+    tar_path = tmp_path / "food-101.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tar:
+        tar.add(food101_tree, arcname="food-101")
+    train_ds, test_ds = create_food101_datasets(
+        str(tar_path), str(tmp_path / "out2")
+    )
+    assert train_ds.count_rows() == 8 and test_ds.count_rows() == 4
+
+
+def test_ingest_on_process_zero(tmp_path, monkeypatch):
+    from lance_distributed_training_tpu.data import (
+        create_synthetic_classification_dataset,
+        ingest_on_process_zero,
+    )
+    import lance_distributed_training_tpu.data.authoring as authoring_mod
+    from lance_distributed_training_tpu.parallel import mesh as mesh_mod
+
+    uri = str(tmp_path / "ds")
+    barriers = []
+    monkeypatch.setattr(
+        mesh_mod, "sync_global_devices", lambda name: barriers.append(name)
+    )
+
+    calls = []
+
+    def ingest():
+        calls.append("ingest")
+        create_synthetic_classification_dataset(uri, rows=32, image_size=16)
+
+    # Process 0 of 2: ingests, then hits the barrier.
+    monkeypatch.setattr(mesh_mod, "process_topology", lambda: (0, 2))
+    ds = ingest_on_process_zero(uri, ingest)
+    assert calls == ["ingest"] and len(barriers) == 1
+    assert ds.count_rows() == 32
+
+    # Process 1 of 2 (dataset now exists): must NOT ingest, must barrier.
+    monkeypatch.setattr(mesh_mod, "process_topology", lambda: (1, 2))
+    ds2 = ingest_on_process_zero(uri, ingest)
+    assert calls == ["ingest"] and len(barriers) == 2
+    assert ds2.count_rows() == 32

@@ -1,0 +1,57 @@
+"""CLI contract tests: rendezvous ordering, flag plumbing."""
+
+import pytest
+
+import lance_distributed_training_tpu.cli as cli
+
+
+def test_rendezvous_precedes_backend_probe(monkeypatch):
+    # torchrun's env-first contract (reference lance_iterable.py:154-156):
+    # multi-host rendezvous must run before ANY backend query — including the
+    # --backend tpu device probe — even when --coordinator_address is absent
+    # and the address comes from the environment.
+    order = []
+
+    import jax
+
+    import lance_distributed_training_tpu.cli as cli_mod
+
+    monkeypatch.setattr(
+        cli_mod,
+        "train",
+        lambda config: order.append("train") or {"loss": 0.0},
+    )
+
+    from lance_distributed_training_tpu.parallel import mesh as mesh_mod
+
+    monkeypatch.setattr(
+        mesh_mod,
+        "maybe_initialize_distributed",
+        lambda *a, **k: order.append("rendezvous"),
+    )
+
+    class _Dev:
+        platform = "tpu"
+
+    monkeypatch.setattr(
+        jax, "devices", lambda *a, **k: order.append("probe") or [_Dev()]
+    )
+
+    cli_mod.main(["--dataset_path", "/nonexistent", "--backend", "tpu",
+                  "--no_wandb"])
+    assert order.index("rendezvous") < order.index("probe")
+
+
+def test_cli_flag_plumbing(monkeypatch):
+    captured = {}
+    monkeypatch.setattr(
+        cli, "train", lambda config: captured.update(config=config) or {}
+    )
+    cli.main([
+        "--dataset_path", "/d", "--shuffle", "--producer_threads", "3",
+        "--batch_size", "64", "--no_wandb",
+    ])
+    config = captured["config"]
+    assert config.shuffle is True
+    assert config.producer_threads == 3
+    assert config.batch_size == 64

@@ -128,3 +128,56 @@ def test_fragment_sampler_through_pipeline(image_dataset):
     ]
     s0, s1 = (sum(1 for _ in p) for p in pipes)
     assert s0 == s1 == max(len(p) for p in pipes)
+
+
+def test_multi_producer_preserves_order(image_dataset):
+    decode = ImageClassificationDecoder(image_size=32)
+    ref = [
+        b["label"].tolist()
+        for b in make_train_pipeline(image_dataset, "batch", 16, 0, 1, decode)
+    ]
+    got = [
+        b["label"].tolist()
+        for b in make_train_pipeline(
+            image_dataset, "batch", 16, 0, 1, decode, producers=3
+        )
+    ]
+    assert got == ref
+
+
+def test_multi_producer_propagates_error(image_dataset):
+    def bad_decode(table):
+        raise RuntimeError("decode exploded")
+
+    pipe = make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1, bad_decode, producers=2
+    )
+    with pytest.raises(RuntimeError, match="decode exploded"):
+        list(pipe)
+
+
+def test_full_scan_multiprocess_refused(image_dataset):
+    # FullScanSampler is "not DP-aware" (reference README.md:126,130-138);
+    # stitching identical per-process scans into a "global" batch silently
+    # duplicates data, so the pipeline must refuse.
+    with pytest.raises(ValueError, match="not DP-aware"):
+        make_train_pipeline(
+            image_dataset, "full", 16, 0, 2,
+            ImageClassificationDecoder(image_size=32),
+        )
+
+
+def test_iterable_shuffle_reorders_batches(image_dataset):
+    decode = ImageClassificationDecoder(image_size=32)
+
+    def labels(epoch):
+        pipe = make_train_pipeline(
+            image_dataset, "batch", 16, 0, 1, decode,
+            shuffle=True, seed=7, epoch=epoch,
+        )
+        return [tuple(b["label"].tolist()) for b in pipe]
+
+    e0, e0_again, e1 = labels(0), labels(0), labels(1)
+    assert e0 == e0_again  # deterministic per epoch
+    assert e0 != e1  # reshuffled across epochs
+    assert sorted(e0) == sorted(e1)  # same batches, new order

@@ -153,3 +153,32 @@ def test_assert_equal_step_counts_raises():
     p2 = [[ReadRange(0, 0, 8)]]
     with pytest.raises(RuntimeError, match="deadlock"):
         assert_equal_step_counts([p0, p2])
+
+
+class TestShardedBatchShuffle:
+    def test_shuffle_keeps_invariants(self):
+        from lance_distributed_training_tpu.data.samplers import (
+            assert_equal_step_counts,
+            sharded_batch_plan,
+        )
+
+        rows = [100, 60, 84]
+        plans = [
+            sharded_batch_plan(rows, 16, p, 2, shuffle=True, seed=3, epoch=5)
+            for p in range(2)
+        ]
+        assert_equal_step_counts(plans, 16)
+        # Disjoint coverage: each global batch (identified by its ranges)
+        # appears on exactly one process.
+        keys = [tuple(tuple(r) for r in step) for plan in plans for step in plan]
+        assert len(keys) == len(set(keys))
+
+    def test_shuffle_epoch_changes_order_not_content(self):
+        from lance_distributed_training_tpu.data.samplers import sharded_batch_plan
+
+        rows = [256]
+        a = sharded_batch_plan(rows, 16, 0, 1, shuffle=True, seed=0, epoch=0)
+        b = sharded_batch_plan(rows, 16, 0, 1, shuffle=True, seed=0, epoch=1)
+        ka = [tuple(tuple(r) for r in s) for s in a]
+        kb = [tuple(tuple(r) for r in s) for s in b]
+        assert ka != kb and sorted(ka) == sorted(kb)

@@ -283,3 +283,30 @@ def test_flash_attention_flag_cpu_fallback(tmp_path):
         eval_at_end=False, flash_attention=True,
     ))
     assert np.isfinite(results["loss"])
+
+
+def test_per_step_progress_lines(image_dataset, capsys, tmp_path, monkeypatch):
+    # The reference streams per-step loss/it-s via tqdm
+    # (lance_iterable.py:106,116-117); train() must emit equivalent per-step
+    # lines at log_every cadence, not just one per epoch.
+    monkeypatch.setenv("LDT_METRICS_PATH", str(tmp_path / "m.jsonl"))
+    train(small_config(image_dataset.uri, log_every=2))
+    lines = [
+        ln for ln in capsys.readouterr().out.splitlines()
+        if ln.startswith("[metrics]") and "images_per_sec" in ln and "step=" in ln
+    ]
+    # 240 rows / batch 32 = 7 steps -> per-step lines at steps 2,4,6 plus the
+    # epoch summary line.
+    per_step = [ln for ln in lines if "epoch_time" not in ln]
+    assert len(per_step) == 3
+    assert all("loss=" in ln and "loader_stall_pct" in ln for ln in per_step)
+
+
+def test_full_scan_multiprocess_raises_in_trainer(image_dataset, monkeypatch):
+    import lance_distributed_training_tpu.trainer as trainer_mod
+
+    monkeypatch.setattr(
+        trainer_mod, "process_topology", lambda: (0, 2)
+    )
+    with pytest.raises(ValueError, match="not DP-aware"):
+        train(small_config(image_dataset.uri, sampler_type="full"))
