@@ -20,7 +20,7 @@ failure.
 Env knobs:
     BENCH_BATCH         per-chip batch size (default 128)
     BENCH_STEPS         measured steps (default 30)
-    BENCH_PRODUCERS     decode-producer threads (default 2)
+    BENCH_PRODUCERS     decode-producer threads (default 4)
     BENCH_PEAK_TFLOPS   per-chip bf16 peak for the MFU estimate (default 197)
     BENCH_MAX_ATTEMPTS  backend-init attempts before giving up (default 5)
     BENCH_BACKOFF_BASE  first retry delay in seconds (default 15)
@@ -131,7 +131,7 @@ def _run(jax, devices) -> dict:
 
     from lance_distributed_training_tpu.native import native_available
 
-    producers = env_int("BENCH_PRODUCERS", 2)
+    producers = env_int("BENCH_PRODUCERS", 4)
     decode = ImageClassificationDecoder(image_size=image_size)
     pipe = make_train_pipeline(
         dataset, "batch", batch_size, 0, 1, decode,
@@ -157,7 +157,12 @@ def _run(jax, devices) -> dict:
         timer.step_start()
         state, loss = step(state, batch, rng)
         if i < warmup:
-            jax.block_until_ready(loss)  # absorb compile into warmup
+            # Value fetch, NOT block_until_ready: on the tunneled TPU
+            # backend block_until_ready returns before execution completes
+            # (verified: 20 chained 4096^3 matmul steps "ready" in 0.5 ms,
+            # real value 1.3 s later), which silently turned every device
+            # timing into dispatch timing. Only a D2H fetch really waits.
+            float(loss)  # absorb compile into warmup
         timer.step_stop()
         if i < warmup:
             log(f"warmup step {i} done")
@@ -166,7 +171,7 @@ def _run(jax, devices) -> dict:
             t0 = time.perf_counter()
             if trace:
                 jax.profiler.start_trace(trace_dir)
-    jax.block_until_ready(loss)
+    float(loss)  # fetch = true completion barrier
     wall = time.perf_counter() - t0
     if trace:
         jax.profiler.stop_trace()
@@ -182,11 +187,11 @@ def _run(jax, devices) -> dict:
     # idleness — device compute overlaps that window via async dispatch.
     dev_steps = min(measure, 10)
     state, dl = step(state, resident, rng)
-    jax.block_until_ready(dl)  # sync before timing
+    float(dl)  # true sync before timing (see warmup note)
     td = time.perf_counter()
     for _ in range(dev_steps):
         state, dl = step(state, resident, rng)
-    jax.block_until_ready(dl)
+    float(dl)  # fetch = true completion barrier
     dev_wall = time.perf_counter() - td
     dev_per_chip = dev_steps * batch_size / dev_wall / n_chips
     log(f"device-only: {dev_per_chip:.1f} img/s/chip "

@@ -51,8 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="token vocabulary; default = the model's own "
                         "(bert_*: 30522, clip_tiny: 1000)")
     p.add_argument("--prefetch", type=int, default=2)
-    p.add_argument("--producer_threads", type=int, default=2,
-                   help="decode-producer threads (cross-batch overlap)")
+    p.add_argument("--producer_threads", type=int, default=4,
+                   help="decode-producer threads (cross-batch decode + "
+                        "H2D overlap)")
     p.add_argument("--shuffle", action="store_true",
                    help="iterable path: reshuffle batch order every epoch "
                         "(same permutation on every process)")

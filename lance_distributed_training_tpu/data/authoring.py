@@ -114,7 +114,7 @@ def create_dataset_from_image_folder(
     return ds
 
 
-def ingest_on_process_zero(output_path: str, ingest_fn) -> Dataset:
+def ingest_on_process_zero(output_path, ingest_fn) -> Dataset:
     """Run ``ingest_fn`` on process 0 only; other processes wait at a global
     barrier, then every process opens the finished dataset.
 
@@ -125,15 +125,28 @@ def ingest_on_process_zero(output_path: str, ingest_fn) -> Dataset:
     process opens the dataset before process 0 finished writing it; the
     writer's final manifest rename is atomic). No-op fast path when the
     dataset already exists everywhere.
+
+    ``output_path`` may be a sequence of paths when ``ingest_fn`` writes
+    several datasets (e.g. :func:`create_food101_datasets`'s train + test):
+    ingestion is skipped only when EVERY manifest exists, so a run killed
+    between the two writes re-ingests instead of being silently skipped
+    forever. Returns the Dataset at the first path.
     """
     from ..parallel.mesh import process_topology, sync_global_devices
 
+    paths = (
+        [str(output_path)]
+        if isinstance(output_path, (str, os.PathLike))
+        else [str(p) for p in output_path]
+    )
     process_index, process_count = process_topology()
-    exists = os.path.exists(os.path.join(str(output_path), "manifest.json"))
+    exists = all(
+        os.path.exists(os.path.join(p, "manifest.json")) for p in paths
+    )
     if (process_index == 0 or process_count == 1) and not exists:
         ingest_fn()
     sync_global_devices("ingest_on_process_zero")
-    return Dataset(output_path)
+    return Dataset(paths[0])
 
 
 def create_food101_datasets(

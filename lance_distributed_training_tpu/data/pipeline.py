@@ -120,6 +120,16 @@ class DataPipeline:
         if self.workers is None and self.producers > 1:
             yield from self._iter_multi_producer()
             return
+        if self.workers is not None and self.producers > 1:
+            import warnings
+
+            warnings.warn(
+                "producers>1 has no effect with a WorkerPool: worker "
+                "processes already decode in parallel and device_put runs "
+                "on the consumer thread (no cross-batch H2D pipelining). "
+                "Drop num_workers to use producer threads instead.",
+                stacklevel=2,
+            )
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
         producer = threading.Thread(
@@ -155,7 +165,15 @@ class DataPipeline:
         total buffered depth ≈ ``max(prefetch, producers)``. Daemon threads +
         the drain in ``finally`` mean a hung decode can never block
         interpreter exit (plain ``ThreadPoolExecutor`` workers would — its
-        atexit hook joins them)."""
+        atexit hook joins them).
+
+        ``device_put_fn`` runs IN the producer threads here (unlike the
+        single-producer path): when the host→device copy is expensive —
+        tunneled TPU clients make ``device_put`` a synchronous RPC costing
+        hundreds of ms per batch — it pipelines across producers instead of
+        serialising on the consumer. device_put is thread-safe and purely
+        data-dependent, so cross-thread dispatch order doesn't matter; the
+        consumer still yields in plan order."""
         n = self.producers
         per = max(1, -(-max(self.prefetch, n) // n))
         queues = [queue.Queue(maxsize=per) for _ in range(n)]
@@ -166,7 +184,10 @@ class DataPipeline:
                 for item in self.plan[k::n]:
                     if stop.is_set():
                         return
-                    queues[k].put(self.decode_fn(self.read_fn(self.dataset, item)))
+                    out = self.decode_fn(self.read_fn(self.dataset, item))
+                    if self.device_put_fn is not None:
+                        out = self.device_put_fn(out)
+                    queues[k].put(out)
                 queues[k].put(_SENTINEL)
             except BaseException as exc:  # surface errors to the consumer
                 queues[k].put(exc)
@@ -195,8 +216,6 @@ class DataPipeline:
                     continue
                 if isinstance(item, BaseException):
                     raise item
-                if self.device_put_fn is not None:
-                    item = self.device_put_fn(item)
                 yield item
         finally:
             stop.set()

@@ -91,7 +91,8 @@ class TrainConfig:
     seq_len: int = 128  # masked_lm / contrastive text length
     vocab_size: Optional[int] = None  # None = the model's own default
     prefetch: int = 2
-    producer_threads: int = 2  # decode-producer threads (cross-batch overlap)
+    producer_threads: int = 4  # decode-producer threads; also pipelines the
+    # per-batch H2D copy (expensive on tunneled TPU clients) across threads
     shuffle: bool = False  # iterable path: epoch batch-order reshuffle
     # (beyond the reference — Lance samplers replay the same order every
     # epoch; map-style shuffles regardless, as DistributedSampler does)
@@ -303,9 +304,11 @@ def evaluate(state, loader, eval_step) -> float:
         batches += 1
         if batches % 32 == 0:
             # Bound dispatch depth: each in-flight eval step pins its batch
-            # on device; one sync per 32 batches caps that without
-            # serialising every step as the reference's .item() did.
-            jax.block_until_ready(correct)
+            # on device; one scalar fetch per 32 batches caps that without
+            # serialising every step as the reference's .item() did. (Fetch,
+            # not block_until_ready — the latter returns early on the
+            # tunneled TPU backend.)
+            _ = float(correct)
     return float(correct) / total if total else 0.0
 
 
@@ -564,17 +567,26 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             # Bound the async dispatch queue (each in-flight step pins its
             # global batch on device) — independent of logging, so neither
             # log_every=0 nor a huge log_every can unbound device memory.
+            # A scalar VALUE fetch, not block_until_ready: on the tunneled
+            # TPU backend block_until_ready returns before execution
+            # completes (verified empirically), so only a D2H fetch
+            # actually drains the queue — and it doubles as honest timing.
+            # Also fetch at log points (log_every may exceed or not divide
+            # sync_every), so the drain lands INSIDE the timed step segment
+            # and the progress window's rate stays honest.
             sync_every = min(config.log_every or 50, 50)
-            if (global_step + 1) % sync_every == 0:
-                jax.block_until_ready(loss)
+            if (global_step + 1) % sync_every == 0 or (
+                config.log_every and (global_step + 1) % config.log_every == 0
+            ):
+                _ = float(loss)  # fetch = drain; value reused at log points
             timer.step_stop()
             global_step += 1
             epoch_step += 1
             if config.log_every and global_step % config.log_every == 0:
                 # Per-step progress — the reference's live tqdm it/s + loss
                 # (lance_iterable.py:106,116-117). Console/JSONL only; wandb
-                # stays on the per-epoch axis. The loss D2H is free: the
-                # block_until_ready above already synced this step.
+                # stays on the per-epoch axis. The loss D2H is cheap: the
+                # fetch above already materialised this step's scalar.
                 w = timer.window()
                 wt = w["loader_s"] + w["step_s"]
                 logger.log(
@@ -594,16 +606,26 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
         if profiling:  # epoch shorter than the trace window
             jax.profiler.stop_trace()
             profiling = False
-        jax.block_until_ready(loss_sum)
+        # Value fetch BEFORE stopping the clock: on the tunneled TPU backend
+        # block_until_ready returns early, so only the D2H fetch guarantees
+        # epoch_time covers all device work.
+        loss_sum_host = float(loss_sum)
         epoch_time = time.perf_counter() - epoch_start
         steps = timer.steps
         epoch_metrics = {
             "epoch": epoch,
-            "loss": float(loss_sum) / max(steps, 1),
+            "loss": loss_sum_host / max(steps, 1),
             "epoch_time": epoch_time,
-            "images_per_sec": timer.images_per_sec(config.batch_size),
-            "images_per_sec_per_chip": timer.images_per_sec(config.batch_size)
-            / n_devices,
+            # Wall-clock rate (the final value fetch above makes epoch_time
+            # cover ALL device work). The StepTimer sums only dispatch time
+            # on async backends, so a timer-based rate overstates throughput;
+            # the timer is kept solely for the host-side stall share.
+            "images_per_sec": config.batch_size * steps / epoch_time
+            if epoch_time > 0 else 0.0,
+            "images_per_sec_per_chip": (
+                config.batch_size * steps / epoch_time / n_devices
+                if epoch_time > 0 else 0.0
+            ),
             "loader_stall_pct": timer.loader_stall_pct,
         }
         if config.eval_every and (epoch + 1) % config.eval_every == 0:

@@ -142,5 +142,9 @@ class StepTimer:
         return 100.0 * self.loader_s / total if total > 0 else 0.0
 
     def images_per_sec(self, batch_size: int) -> float:
+        """Timer-based rate — host dispatch accounting. On async backends
+        the step segments exclude un-fetched device work, so prefer a
+        wall-clock rate (as ``train()``'s epoch metrics do) for throughput
+        claims; this is an upper bound useful for progress lines."""
         total = self.loader_s + self.step_s
         return self.steps * batch_size / total if total > 0 else 0.0
