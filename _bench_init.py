@@ -69,9 +69,9 @@ def env_float(name: str, default: float) -> float:
         return default
 
 
-def emit_error(metric: str, stage: str, error: str, attempts: int,
-               history: list[str] | None = None) -> None:
-    """Final-failure path: one structured JSON line on stdout, then rc=1."""
+def _error_record(metric: str, stage: str, error: str, attempts: int,
+                  history: list[str] | None = None) -> dict:
+    """The one structured-error schema (bench_suite parses these lines)."""
     record = {
         "metric": metric,
         "value": None,
@@ -86,8 +86,71 @@ def emit_error(metric: str, stage: str, error: str, attempts: int,
     }
     if history:
         record["error"]["history"] = history
-    print(json.dumps(record), flush=True)
+    return record
+
+
+def emit_error(metric: str, stage: str, error: str, attempts: int,
+               history: list[str] | None = None) -> None:
+    """Final-failure path: one structured JSON line on stdout, then rc=1."""
+    print(json.dumps(_error_record(metric, stage, error, attempts, history)),
+          flush=True)
     sys.exit(1)
+
+
+class _HangWatchdog:
+    """Treat a ``jax.devices()`` call exceeding ``timeout_s`` as a transient
+    failure: a killed-mid-claim predecessor can leave the tunnel grant stale,
+    and the claim then blocks indefinitely (observed >10 min). Re-exec (the
+    only way to unpoison the backend cache) or, out of attempts, print the
+    structured error line and exit.
+
+    Race-safe: ``done()`` and ``_fire()`` serialise on a lock, so a claim
+    that succeeds right at the timeout can never be re-exec'd away or
+    misreported as a failure after the main thread proceeds.
+    """
+
+    def __init__(self, timeout_s: float, attempt: int, max_attempts: int,
+                 metric: str):
+        import threading
+
+        self._lock = threading.Lock()
+        self._done = False
+        self._timeout_s = timeout_s
+        self._attempt = attempt
+        self._max_attempts = max_attempts
+        self._metric = metric
+        self._timer = threading.Timer(timeout_s, self._fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def done(self) -> None:
+        with self._lock:
+            self._done = True
+        self._timer.cancel()
+
+    def _fire(self) -> None:
+        with self._lock:
+            if self._done:
+                return
+            err = f"hang: jax.devices() exceeded {self._timeout_s:.0f}s"
+            log(f"backend init HUNG (> {self._timeout_s:.0f}s)")
+            history = [
+                h for h in os.environ.get(_ERRLOG_ENV, "").split(_SEP) if h
+            ]
+            history.append(f"attempt {self._attempt}: {err}")
+            if self._attempt >= self._max_attempts:
+                print(json.dumps(_error_record(
+                    self._metric, "backend_init", err, self._attempt, history
+                )), flush=True)
+                os._exit(1)
+            env = dict(os.environ)
+            env[_ATTEMPT_ENV] = str(self._attempt + 1)
+            env[_ERRLOG_ENV] = _SEP.join(history)[-4000:]
+            os.execve(
+                sys.executable,
+                [sys.executable, os.path.abspath(sys.argv[0])] + sys.argv[1:],
+                env,
+            )
 
 
 def init_devices(metric: str):
@@ -96,9 +159,11 @@ def init_devices(metric: str):
     On a transient failure, sleeps with exponential backoff and re-execs
     this process (incrementing an attempt counter carried in the
     environment). On a permanent failure or attempt exhaustion, emits the
-    structured error JSON line and exits 1. May legitimately BLOCK for a
-    long time inside ``jax.devices()`` while queued behind an expiring
-    grant — callers/operators must not wrap this in ``timeout``.
+    structured error JSON line and exits 1. ``jax.devices()`` may
+    legitimately block for minutes while queued behind an expiring grant;
+    a hang beyond ``BENCH_INIT_TIMEOUT`` seconds (default 900) is treated
+    as transient and re-exec'd by a watchdog — so operators still must not
+    wrap this script in a bare ``timeout``.
     """
     attempt = env_int(_ATTEMPT_ENV, 1)
     max_attempts = env_int("BENCH_MAX_ATTEMPTS", 5)
@@ -115,9 +180,14 @@ def init_devices(metric: str):
 
     log(f"backend init attempt {attempt}/{max_attempts} (jax {jax.__version__}, "
         f"JAX_PLATFORMS={'<unset>' if env_platforms is None else env_platforms!r})")
+    watchdog = _HangWatchdog(
+        env_float("BENCH_INIT_TIMEOUT", 900.0), attempt, max_attempts, metric
+    )
     try:
         devices = jax.devices()
+        watchdog.done()
     except Exception as e:  # noqa: BLE001 — classified below
+        watchdog.done()
         err = f"{type(e).__name__}: {e}"
         log(f"backend init FAILED: {err}")
         history = [h for h in os.environ.get(_ERRLOG_ENV, "").split(_SEP) if h]

@@ -230,6 +230,11 @@ def _run(jax, devices) -> dict:
         # blocked on next(batch). Decode-bound evidence, not device idle%.
         "loader_stall_pct": round(timer.loader_stall_pct, 2),
         "stall_basis": "host_wall_share",
+        # Wall clock closed by a scalar VALUE fetch. Earlier rounds used
+        # block_until_ready, which returns before execution completes on
+        # tunneled TPU backends — those numbers measured dispatch, not
+        # throughput, and are not comparable.
+        "timing_basis": "wall_clock_value_fetch",
         "device_only_images_per_sec_per_chip": round(dev_per_chip, 2),
         "device_step_ms": round(dev_wall / dev_steps * 1e3, 2),
         "device_busy_pct_est": round(

@@ -68,6 +68,15 @@ class ImageClassificationDecoder:
         self.use_native = use_native
         self._bind_native()
 
+    @property
+    def required_columns(self) -> list[str]:
+        """Columns this decoder reads — the pipelines project reads to these
+        (Lance scanner column selection; unused columns never leave disk)."""
+        cols = [self.image_column]
+        if self.label_column is not None:
+            cols.append(self.label_column)
+        return cols
+
     def _bind_native(self) -> None:
         self._native = None
         self._native_arrow = None

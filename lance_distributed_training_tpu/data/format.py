@@ -117,8 +117,15 @@ class _FragmentReader:
             pieces.append(batch.slice(lo, hi - lo))
         return pa.Table.from_batches(pieces, schema=self._reader.schema)
 
-    def take(self, indices: Sequence[int]) -> pa.Table:
-        """Random-access rows by fragment-local index (preserves order)."""
+    def take(
+        self,
+        indices: Sequence[int],
+        columns: Optional[Sequence[str]] = None,
+    ) -> pa.Table:
+        """Random-access rows by fragment-local index (preserves order).
+        ``columns`` projects BEFORE the gather (``select`` is a zero-copy
+        view; ``take`` copies values) so unused columns are never
+        materialised."""
         if self._table is None:
             # Assemble once per reader: the batches are zero-copy views into
             # the memory map, so this caches only metadata — rebuilding it per
@@ -130,7 +137,8 @@ class _FragmentReader:
                 ],
                 schema=self._reader.schema,
             )
-        return self._table.take(pa.array(np.asarray(indices, dtype=np.int64)))
+        table = self._table if columns is None else self._table.select(columns)
+        return table.take(pa.array(np.asarray(indices, dtype=np.int64)))
 
 
 class Dataset:
@@ -256,12 +264,17 @@ class Dataset:
         indices: Sequence[int],
         columns: Optional[Sequence[str]] = None,
     ) -> pa.Table:
-        """Random-access global rows, result in the order of ``indices``."""
+        """Random-access global rows, result in the order of ``indices``.
+        ``columns`` projects at the fragment readers — before any gather —
+        so unused columns are never copied."""
         indices = np.asarray(indices, dtype=np.int64)
         if indices.size == 0:
-            return pa.table(
-                {f.name: pa.array([], type=f.type) for f in self.schema}
+            fields = (
+                self.schema
+                if columns is None
+                else [self.schema.field(c) for c in columns]
             )
+            return pa.table({f.name: pa.array([], type=f.type) for f in fields})
         frag_ids, local = self._locate(indices)
         # Gather per-fragment (grouped, order-preserving within each group),
         # then restore the caller's order with one permutation take.
@@ -269,12 +282,13 @@ class Dataset:
         pieces = []
         for fid in np.unique(frag_ids):
             group = order[frag_ids[order] == fid]
-            pieces.append(self._reader(int(fid)).take(local[group]))
+            pieces.append(
+                self._reader(int(fid)).take(local[group], columns=columns)
+            )
         combined = pa.concat_tables(pieces)  # row k ↔ original position order[k]
         inverse = np.empty_like(order)
         inverse[order] = np.arange(order.size)
-        result = combined.take(pa.array(inverse))
-        return result.select(columns) if columns is not None else result
+        return combined.take(pa.array(inverse))
 
     def take_batch(self, indices: Sequence[int]) -> pa.RecordBatch:
         return self.take(indices).combine_chunks().to_batches()[0]

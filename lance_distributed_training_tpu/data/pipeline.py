@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from functools import partial
 from typing import Callable, Iterator, Optional, Sequence
 
 import numpy as np
@@ -41,15 +42,35 @@ __all__ = ["DataPipeline", "MapStylePipeline", "make_train_pipeline", "make_map_
 _SENTINEL = object()
 
 
-def _range_read(dataset: Dataset, ranges: Sequence[ReadRange]) -> pa.Table:
-    """Streaming read: concatenate the step's row-ranges (iterable path)."""
-    tables = [dataset.read_range(r.fragment, r.start, r.stop) for r in ranges]
+def _range_read(
+    dataset: Dataset,
+    ranges: Sequence[ReadRange],
+    columns: Optional[Sequence[str]] = None,
+) -> pa.Table:
+    """Streaming read: concatenate the step's row-ranges (iterable path).
+    ``columns`` projects at the fragment reader (the Lance scanner's column
+    selection — zero-copy, skips unused columns entirely)."""
+    tables = [
+        dataset.read_range(r.fragment, r.start, r.stop, columns=columns)
+        for r in ranges
+    ]
     return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
 
 
-def _take_read(dataset: Dataset, indices: np.ndarray) -> pa.Table:
+def _take_read(
+    dataset: Dataset,
+    indices: np.ndarray,
+    columns: Optional[Sequence[str]] = None,
+) -> pa.Table:
     """Random-access read: global-index gather (map-style path)."""
-    return dataset.take(indices)
+    return dataset.take(indices, columns=columns)
+
+
+def _with_columns(read_fn: Callable, columns) -> Callable:
+    """Bind a column projection into a read_fn (no-op when columns is None)."""
+    if columns is None:
+        return read_fn
+    return partial(read_fn, columns=list(columns))
 
 
 class DataPipeline:
@@ -245,6 +266,7 @@ def make_train_pipeline(
     shuffle: bool = False,
     seed: int = 0,
     epoch: int = 0,
+    columns: Optional[Sequence[str]] = None,
 ) -> DataPipeline:
     """Iterable-style pipeline — parity with ``get_sampler``+``get_dataset``+
     ``get_loader`` (``/root/reference/lance_iterable.py:53-72,86-88``).
@@ -280,6 +302,7 @@ def make_train_pipeline(
         plan = make_plan(sampler_type, rows, batch_size, process_index,
                          process_count, shuffle=shuffle, seed=seed, epoch=epoch)
     return DataPipeline(dataset, plan, decode_fn, device_put_fn, prefetch,
+                        read_fn=_with_columns(_range_read, columns),
                         workers=workers, producers=producers)
 
 
@@ -308,6 +331,7 @@ class MapStylePipeline:
         prefetch: int = 2,
         workers=None,
         producers: int = 1,
+        columns: Optional[Sequence[str]] = None,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -322,6 +346,7 @@ class MapStylePipeline:
         self.prefetch = prefetch
         self.workers = workers
         self.producers = producers
+        self.columns = list(columns) if columns is not None else None
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -349,7 +374,7 @@ class MapStylePipeline:
                 self.decode_fn,
                 self.device_put_fn,
                 self.prefetch,
-                read_fn=_take_read,
+                read_fn=_with_columns(_take_read, self.columns),
                 workers=self.workers,
                 producers=self.producers,
             )

@@ -52,7 +52,7 @@ def folder_spec(samples: Sequence[Tuple[str, int]]) -> Tuple[str, object]:
     return ("folder", list(samples))
 
 
-def _init_worker(reader_spec, decode_fn) -> None:
+def _init_worker(reader_spec, decode_fn, columns=None) -> None:
     global _STATE
     kind, payload = reader_spec
     if kind == "columnar":
@@ -63,11 +63,13 @@ def _init_worker(reader_spec, decode_fn) -> None:
         reader = payload
     else:
         raise ValueError(f"unknown reader spec kind {kind!r}")
-    _STATE = (kind, reader, decode_fn)
+    _STATE = (kind, reader, decode_fn, columns)
 
 
-def _read_item(kind: str, reader, item) -> pa.Table:
+def _read_item(kind: str, reader, item, columns=None) -> pa.Table:
     if kind == "folder":
+        # Folder reads always produce exactly {image, label}; nothing to
+        # project.
         payloads, labels = [], []
         for i in np.asarray(item):
             path, label = reader[int(i)]
@@ -79,16 +81,19 @@ def _read_item(kind: str, reader, item) -> pa.Table:
              "label": pa.array(labels, pa.int64())}
         )
     if isinstance(item, np.ndarray):  # map-style: global-index take
-        return reader.take(item)
+        return reader.take(item, columns=columns)
     # iterable-style: list of ReadRange
-    tables = [reader.read_range(r.fragment, r.start, r.stop) for r in item]
+    tables = [
+        reader.read_range(r.fragment, r.start, r.stop, columns=columns)
+        for r in item
+    ]
     return pa.concat_tables(tables) if len(tables) > 1 else tables[0]
 
 
 def _run_item(item):
     assert _STATE is not None, "worker not initialized"
-    kind, reader, decode_fn = _STATE
-    return decode_fn(_read_item(kind, reader, item))
+    kind, reader, decode_fn, columns = _STATE
+    return decode_fn(_read_item(kind, reader, item, columns))
 
 
 class WorkerPool:
@@ -104,6 +109,7 @@ class WorkerPool:
         reader_spec: Tuple[str, object],
         decode_fn: Callable,
         num_workers: int,
+        columns: Optional[Sequence[str]] = None,
     ):
         if num_workers < 1:
             raise ValueError("WorkerPool needs num_workers >= 1")
@@ -114,7 +120,8 @@ class WorkerPool:
             max_workers=num_workers,
             mp_context=mp.get_context("spawn"),
             initializer=_init_worker,
-            initargs=(reader_spec, decode_fn),
+            initargs=(reader_spec, decode_fn,
+                      list(columns) if columns is not None else None),
         )
 
     def imap(self, items: Iterable, window: int = 0) -> Iterator[dict]:

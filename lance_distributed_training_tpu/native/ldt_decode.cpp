@@ -96,9 +96,18 @@ int decode_one(const uint8_t* data, size_t len, int out_size, uint8_t* dst,
   jpeg_start_decompress(&cinfo);
   const int sw = cinfo.output_width, sh = cinfo.output_height;
   const size_t row_bytes = (size_t)sw * cinfo.output_components;
-  scratch.resize(row_bytes * sh);
+  const bool direct =
+      sw == out_size && sh == out_size && cinfo.output_components == 3;
+  uint8_t* sink = dst;
+  if (!direct) {
+    scratch.resize(row_bytes * sh);
+    sink = scratch.data();
+  }
+  // Already at target size: decode scanlines straight into the caller's
+  // batch slot — no scratch buffer, no copy. Otherwise decode to scratch
+  // and resize.
   while (cinfo.output_scanline < cinfo.output_height) {
-    uint8_t* row = scratch.data() + (size_t)cinfo.output_scanline * row_bytes;
+    uint8_t* row = sink + (size_t)cinfo.output_scanline * row_bytes;
     jpeg_read_scanlines(&cinfo, &row, 1);
   }
   // out_color_space was forced to JCS_RGB before jpeg_start_decompress, so
@@ -109,9 +118,7 @@ int decode_one(const uint8_t* data, size_t len, int out_size, uint8_t* dst,
   jpeg_destroy_decompress(&cinfo);
   if (components != 3) return 2;
 
-  if (sw == out_size && sh == out_size) {
-    std::memcpy(dst, scratch.data(), (size_t)out_size * out_size * 3);
-  } else {
+  if (!direct) {
     resize_bilinear(scratch.data(), sw, sh, dst, out_size, out_size);
   }
   return 0;

@@ -334,13 +334,15 @@ def _make_worker_pool(config: TrainConfig, dataset):
     from .data.workers import WorkerPool, columnar_spec, folder_spec
 
     decode = _decoder_for(config)
+    columns = getattr(decode, "required_columns", None)
     if config.data_format == "folder":
         from .data.authoring import _folder_samples
 
         samples, _ = _folder_samples(config.dataset_path)
         return WorkerPool(folder_spec(samples), decode, config.num_workers)
     return WorkerPool(
-        columnar_spec(config.dataset_path), decode, config.num_workers
+        columnar_spec(config.dataset_path), decode, config.num_workers,
+        columns=columns,
     )
 
 
@@ -389,6 +391,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
                 "would be silently clamped by the XLA gather"
             )
         return loader
+    columns = getattr(decode, "required_columns", None)
     if config.loader_style == "map":
         loader = MapStylePipeline(
             dataset,
@@ -402,6 +405,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             prefetch=config.prefetch,
             workers=workers,
             producers=config.producer_threads,
+            columns=columns,
         )
     else:
         loader = make_train_pipeline(
@@ -418,6 +422,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             shuffle=config.shuffle,
             seed=config.seed,
             epoch=epoch,
+            columns=columns,
         )
     if len(loader) == 0:
         raise ValueError(

@@ -181,3 +181,39 @@ def test_iterable_shuffle_reorders_batches(image_dataset):
     assert e0 == e0_again  # deterministic per epoch
     assert e0 != e1  # reshuffled across epochs
     assert sorted(e0) == sorted(e1)  # same batches, new order
+
+
+def test_column_projection_iterable(tmp_path, image_table):
+    # Extra column in the schema must never reach the decoder when the
+    # pipeline projects (Lance scanner column selection).
+    extra = image_table.append_column(
+        "weight", pa.array(np.arange(240, dtype=np.float64))
+    )
+    ds = write_dataset(extra, tmp_path / "wide", mode="create",
+                       max_rows_per_file=100)
+    seen_schemas = []
+
+    def probe_decode(table):
+        seen_schemas.append(table.column_names)
+        return {"n": np.asarray([table.num_rows])}
+
+    pipe = make_train_pipeline(
+        ds, "batch", 32, 0, 1, probe_decode, columns=["image", "label"]
+    )
+    assert len(list(pipe)) == 240 // 32
+    assert all(names == ["image", "label"] for names in seen_schemas)
+
+
+def test_column_projection_map_style(tmp_path, image_table):
+    extra = image_table.append_column(
+        "weight", pa.array(np.arange(240, dtype=np.float64))
+    )
+    ds = write_dataset(extra, tmp_path / "wide2", mode="create",
+                       max_rows_per_file=100)
+    decode = ImageClassificationDecoder(image_size=32)
+    assert decode.required_columns == ["image", "label"]
+    pipe = MapStylePipeline(ds, 16, 0, 1, decode,
+                            columns=decode.required_columns)
+    batch = next(iter(pipe))
+    assert set(batch) == {"image", "label"}
+    assert batch["image"].shape == (16, 32, 32, 3)

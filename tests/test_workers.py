@@ -135,3 +135,37 @@ def test_train_with_num_workers(tmp_path, image_dataset):
     results = train(cfg)
     assert np.isfinite(results["loss"])
     assert results["epoch"] == 0
+
+
+class _ProjectionProbe:
+    """Picklable decode hook asserting the projection happened in-worker."""
+
+    def __call__(self, table):
+        assert table.column_names == ["label"], table.column_names
+        return {"label": table.column("label").to_numpy(zero_copy_only=False)}
+
+
+def test_worker_pool_column_projection(tmp_path, image_table):
+    import numpy as np
+    import pyarrow as pa
+
+    from lance_distributed_training_tpu.data import (
+        MapStylePipeline,
+        WorkerPool,
+        columnar_spec,
+        write_dataset,
+    )
+
+    extra = image_table.append_column(
+        "weight", pa.array(np.arange(240, dtype=np.float64))
+    )
+    ds = write_dataset(extra, tmp_path / "wide", mode="create",
+                       max_rows_per_file=100)
+
+    probe_decode = _ProjectionProbe()
+    with WorkerPool(columnar_spec(ds.uri), probe_decode, 2,
+                    columns=["label"]) as pool:
+        pipe = MapStylePipeline(ds, 16, 0, 1, probe_decode, workers=pool)
+        batches = list(pipe)
+    assert len(batches) == 240 // 16
+    assert all(set(b) == {"label"} for b in batches)
