@@ -104,9 +104,18 @@ class _HangWatchdog:
     only way to unpoison the backend cache) or, out of attempts, print the
     structured error line and exit.
 
-    Race-safe: ``done()`` and ``_fire()`` serialise on a lock, so a claim
-    that succeeds right at the timeout can never be re-exec'd away or
-    misreported as a failure after the main thread proceeds.
+    The lock between ``done()`` and ``_fire()`` guarantees the watchdog
+    never acts after the main thread has proceeded past ``done()``; a claim
+    that completes in the instant the timer is already firing can still be
+    discarded (earlier attempts) or reported as failed (final attempt) —
+    that residual window is milliseconds against a default 900 s timeout.
+
+    Re-exec'ing while our own claim RPC is in flight can itself leave a
+    stale grant (the very condition that causes these hangs), so a fresh
+    attempt may hang again until the server-side grant TTL lapses. That is
+    still strictly better than the alternative — a process blocked forever —
+    and the standard exponential backoff is applied before the re-exec to
+    give the TTL time to expire.
     """
 
     def __init__(self, timeout_s: float, attempt: int, max_attempts: int,
@@ -143,6 +152,11 @@ class _HangWatchdog:
                     self._metric, "backend_init", err, self._attempt, history
                 )), flush=True)
                 os._exit(1)
+            backoff_base = env_float("BENCH_BACKOFF_BASE", 15.0)
+            delay = min(300.0, backoff_base * (2 ** (self._attempt - 1)))
+            log(f"sleeping {delay:.0f}s then re-exec "
+                f"(attempt {self._attempt + 1})")
+            time.sleep(delay)
             env = dict(os.environ)
             env[_ATTEMPT_ENV] = str(self._attempt + 1)
             env[_ERRLOG_ENV] = _SEP.join(history)[-4000:]

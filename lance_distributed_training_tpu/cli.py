@@ -24,7 +24,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--val_dataset_path", type=str, default=None,
                    help="held-out split for evaluation (default: train loader)")
     p.add_argument("--task_type", type=str, default="classification",
-                   choices=["classification", "masked_lm", "contrastive"])
+                   choices=["classification", "masked_lm", "causal_lm",
+                            "contrastive"])
     p.add_argument("--num_classes", type=int, default=101)
     p.add_argument("--sampler_type", type=str, default="batch",
                    choices=["batch", "fragment", "full",

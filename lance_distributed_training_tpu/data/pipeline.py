@@ -151,6 +151,24 @@ class DataPipeline:
                 "Drop num_workers to use producer threads instead.",
                 stacklevel=2,
             )
+        if self.workers is not None and (
+            getattr(self.read_fn, "func", None) in (_range_read, _take_read)
+        ):
+            # Projection was bound into read_fn, but worker-pool reads bypass
+            # read_fn entirely — they project with the POOL's columns. Warn
+            # when the two disagree (trainer passes the same list to both).
+            bound = self.read_fn.keywords.get("columns")
+            pool_cols = getattr(self.workers, "columns", None)
+            if bound != pool_cols:
+                import warnings
+
+                warnings.warn(
+                    f"pipeline columns {bound} differ from the WorkerPool's "
+                    f"{pool_cols}; reads run inside the pool, so pass the "
+                    "same columns= to WorkerPool(...) for the projection to "
+                    "apply.",
+                    stacklevel=2,
+                )
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
         producer = threading.Thread(

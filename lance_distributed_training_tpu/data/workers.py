@@ -114,6 +114,7 @@ class WorkerPool:
         if num_workers < 1:
             raise ValueError("WorkerPool needs num_workers >= 1")
         self.num_workers = num_workers
+        self.columns = list(columns) if columns is not None else None
         # Spawn, not fork: fork would inherit locks/ctypes handles mid-state —
         # the exact hazard upstream's SafeLanceDataset exists to avoid.
         self._pool = ProcessPoolExecutor(

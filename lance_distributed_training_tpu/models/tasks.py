@@ -27,7 +27,7 @@ import optax
 from ..ops.image import normalize_images, random_flip
 from . import resnet as _resnet
 from .clip import CLIP, clip_contrastive_loss, clip_resnet50_bert, clip_tiny
-from .transformer import bert_base, bert_small
+from .transformer import bert_base, bert_small, gpt_base, gpt_small
 
 __all__ = ["Task", "get_task", "TASK_REGISTRY"]
 
@@ -171,6 +171,68 @@ def _masked_lm_task(vocab_size: Optional[int], model_name: str, seq_len: int,
 
     return Task("masked_lm", model, init_variables, forward, loss, metric,
                 metric_name="masked_token_accuracy")
+
+
+# ---------------------------------------------------------------- causal LM
+def _causal_lm_task(vocab_size: Optional[int], model_name: str, seq_len: int,
+                    attention_fn: Optional[Callable] = None,
+                    remat: bool = False, num_experts: int = 0,
+                    moe_every: int = 2,
+                    aux_loss_weight: float = 0.01) -> Task:
+    """Decoder-only next-token prediction (GPT family) over the same packed
+    token columns as masked-LM (``create_text_token_dataset``) — the text arm
+    beyond the reference's vision-only scope, sharing the trainer, samplers
+    and storage unchanged."""
+    ctor = {"gpt_base": gpt_base, "gpt_small": gpt_small}.get(model_name)
+    if ctor is None:
+        raise ValueError(f"Invalid model name: {model_name} "
+                         "(have ['gpt_base', 'gpt_small'])")
+    model = ctor(vocab_size=vocab_size or 50257, max_len=seq_len,
+                 attention_fn=attention_fn, remat=remat,
+                 num_experts=num_experts, moe_every=moe_every)
+
+    def init_variables(rng):
+        ids = jnp.zeros((1, seq_len), jnp.int32)
+        return model.init(rng, ids, jnp.ones((1, seq_len), jnp.int8),
+                          train=False)
+
+    def forward(variables, batch, train, rng):
+        ids = batch["input_ids"].astype(jnp.int32)
+        mask = batch["attention_mask"]
+        aux = jnp.zeros((), jnp.float32)
+        if train and num_experts > 0:
+            logits, sown = model.apply(
+                variables, ids, mask, train=True, mutable=["aux_loss"]
+            )
+            for leaf in jax.tree_util.tree_leaves(sown.get("aux_loss", {})):
+                aux = aux + leaf
+        else:
+            logits = model.apply(variables, ids, mask, train=train)
+        return (logits, aux), None
+
+    def _shifted(outputs, batch):
+        logits, aux = outputs
+        ids = batch["input_ids"].astype(jnp.int32)
+        # Predict token t+1 from positions <= t; weight by the target's
+        # validity so padding after a final partial pack contributes nothing.
+        targets = ids[:, 1:]
+        w = batch["attention_mask"][:, 1:].astype(jnp.float32)
+        return logits[:, :-1], targets, w, aux
+
+    def loss(outputs, batch):
+        logits, targets, w, aux = _shifted(outputs, batch)
+        raw = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        return (raw * w).sum() / jnp.maximum(w.sum(), 1.0) + (
+            aux_loss_weight * aux
+        )
+
+    def metric(outputs, batch):
+        logits, targets, w, _aux = _shifted(outputs, batch)
+        hit = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+        return (hit * w).sum(-1) / jnp.maximum(w.sum(-1), 1.0)
+
+    return Task("causal_lm", model, init_variables, forward, loss, metric,
+                metric_name="next_token_accuracy")
 
 
 # ------------------------------------------------------- pipelined masked LM
@@ -373,6 +435,14 @@ def get_task(
         return _masked_lm_task(vocab_size, model_name or "bert_base", seq_len,
                                attention_fn=attention_fn, remat=remat,
                                num_experts=num_experts, moe_every=moe_every)
+    if task_type == "causal_lm":
+        if pipeline_parallelism > 1:
+            raise ValueError(
+                "pipeline_parallelism supports masked_lm only in this release"
+            )
+        return _causal_lm_task(vocab_size, model_name or "gpt_base", seq_len,
+                               attention_fn=attention_fn, remat=remat,
+                               num_experts=num_experts, moe_every=moe_every)
     if task_type == "contrastive":
         return _contrastive_task(
             model_name or "clip_resnet50_bert", image_size, seq_len,
@@ -382,4 +452,4 @@ def get_task(
     raise ValueError(f"Invalid task type: {task_type}")
 
 
-TASK_REGISTRY = ("classification", "masked_lm", "contrastive")
+TASK_REGISTRY = ("classification", "masked_lm", "causal_lm", "contrastive")

@@ -20,17 +20,25 @@ from typing import Any, Callable, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-__all__ = ["TransformerEncoder", "bert_base", "bert_small", "dot_product_attention"]
+__all__ = ["TransformerEncoder", "bert_base", "bert_small", "gpt_base",
+           "gpt_small", "dot_product_attention"]
 
 
-def dot_product_attention(q, k, v, mask=None, dtype=jnp.bfloat16):
+def dot_product_attention(q, k, v, mask=None, dtype=jnp.bfloat16,
+                          causal=False):
     """Standard softmax attention: q,k,v [B, H, S, D] → [B, H, S, D].
 
     Softmax statistics in f32 for stability; matmuls in ``dtype`` on the MXU.
+    ``causal=True`` adds the autoregressive lower-triangular mask (decoder
+    attention) on top of any key-validity ``mask``.
     """
     d = q.shape[-1]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
     scores = scores / jnp.sqrt(d).astype(jnp.float32)
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        tri = jnp.tril(jnp.ones((s_q, s_k), bool))[None, None]
+        scores = jnp.where(tri, scores, jnp.finfo(jnp.float32).min)
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     weights = nn.softmax(scores, axis=-1)
@@ -41,6 +49,8 @@ class SelfAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
+    causal: bool = False  # decoder (GPT) attention; custom attention_fns
+    # must bind their own causality (e.g. make_flash_attention(causal=True))
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -54,7 +64,9 @@ class SelfAttention(nn.Module):
         v = dense(features=(self.num_heads, head_dim), name="value")(x)
         # [B, S, H, D] -> [B, H, S, D]
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-        attn = self.attention_fn or partial(dot_product_attention, dtype=self.dtype)
+        attn = self.attention_fn or partial(
+            dot_product_attention, dtype=self.dtype, causal=self.causal
+        )
         out = attn(q, k, v, mask=mask)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, h)
         return dense(features=h, axis=-1, name="out")(out)
@@ -67,13 +79,15 @@ class EncoderBlock(nn.Module):
     attention_fn: Optional[Callable] = None
     num_experts: int = 0  # >0: switch-MoE MLP instead of dense (expert parallel)
     capacity_factor: float = 1.25
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None):
         norm = partial(nn.LayerNorm, dtype=self.dtype, param_dtype=jnp.float32)
         y = norm(name="ln_attn")(x)
         y = SelfAttention(self.num_heads, self.dtype,
-                          attention_fn=self.attention_fn, name="attn")(y, mask)
+                          attention_fn=self.attention_fn,
+                          causal=self.causal, name="attn")(y, mask)
         x = x + y
         y = norm(name="ln_mlp")(x)
         if self.num_experts > 0:
@@ -111,6 +125,7 @@ class TransformerEncoder(nn.Module):
     num_experts: int = 0  # >0: MoE MLP on every `moe_every`-th block
     moe_every: int = 2
     capacity_factor: float = 1.25
+    causal: bool = False  # decoder-only (GPT) variant: autoregressive mask
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, train: bool = True):
@@ -142,6 +157,7 @@ class TransformerEncoder(nn.Module):
                       attention_fn=self.attention_fn,
                       num_experts=self.num_experts if moe_here else 0,
                       capacity_factor=self.capacity_factor,
+                      causal=self.causal,
                       name=f"layer_{i}")(x, mask)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_final")(x)
@@ -156,3 +172,9 @@ bert_base = partial(TransformerEncoder, hidden_size=768, num_layers=12,
                     num_heads=12, mlp_dim=3072)
 bert_small = partial(TransformerEncoder, hidden_size=256, num_layers=4,
                      num_heads=4, mlp_dim=1024)
+# Decoder-only (GPT-style) presets: same trunk, causal attention, tied LM
+# head. gpt_base matches GPT-2 124M's shape (768/12/12).
+gpt_base = partial(TransformerEncoder, hidden_size=768, num_layers=12,
+                   num_heads=12, mlp_dim=3072, causal=True)
+gpt_small = partial(TransformerEncoder, hidden_size=256, num_layers=4,
+                    num_heads=4, mlp_dim=1024, causal=True)

@@ -150,11 +150,15 @@ def _task_from_config(config: TrainConfig, mesh=None) -> Task:
                 "pipeline_parallelism>1 requires a sequence model (masked_lm)"
             )
     elif config.flash_attention:
-        if config.task_type != "masked_lm":
+        if config.task_type not in ("masked_lm", "causal_lm"):
             raise ValueError("flash_attention requires a sequence model")
         from .ops.flash import make_flash_attention
 
-        attention_fn = make_flash_attention()
+        # causal_lm binds the kernel's fused autoregressive masking (also
+        # skips the fully-masked upper blocks).
+        attention_fn = make_flash_attention(
+            causal=config.task_type == "causal_lm"
+        )
     return get_task(
         config.task_type,
         num_classes=config.num_classes,
@@ -315,7 +319,7 @@ def evaluate(state, loader, eval_step) -> float:
 def _decoder_for(config: TrainConfig):
     if config.task_type == "classification":
         return ImageClassificationDecoder(image_size=config.image_size)
-    if config.task_type == "masked_lm":
+    if config.task_type in ("masked_lm", "causal_lm"):
         return numeric_decoder
     if config.task_type == "contrastive":
         from .data.decode import ImageTextDecoder

@@ -310,3 +310,19 @@ def test_full_scan_multiprocess_raises_in_trainer(image_dataset, monkeypatch):
     )
     with pytest.raises(ValueError, match="not DP-aware"):
         train(small_config(image_dataset.uri, sampler_type="full"))
+
+
+def test_causal_lm_end_to_end(tmp_path):
+    from lance_distributed_training_tpu.data import create_text_token_dataset
+
+    gen = np.random.default_rng(0)
+    docs = [gen.integers(2, 128, 24).tolist() for _ in range(80)]
+    uri = str(tmp_path / "tok")
+    create_text_token_dataset(uri, docs, seq_len=16, fragment_size=64)
+    results = train(TrainConfig(
+        dataset_path=uri, task_type="causal_lm", model_name="gpt_small",
+        vocab_size=128, seq_len=16, batch_size=16, epochs=2, lr=0.05,
+        no_wandb=True, eval_at_end=True,
+    ))
+    assert np.isfinite(results["loss"])
+    assert 0.0 <= results["train_acc"] <= 1.0
