@@ -105,10 +105,13 @@ class _HangWatchdog:
     structured error line and exit.
 
     The lock between ``done()`` and ``_fire()`` guarantees the watchdog
-    never acts after the main thread has proceeded past ``done()``; a claim
-    that completes in the instant the timer is already firing can still be
-    discarded (earlier attempts) or reported as failed (final attempt) —
-    that residual window is milliseconds against a default 900 s timeout.
+    never acts after the main thread has proceeded past ``done()``. The
+    backoff sleep runs OUTSIDE the lock and ``_done`` is re-checked before
+    the re-exec, so a claim that completes during the (up to 300 s) backoff
+    is kept, not discarded — ``done()`` never blocks on the watchdog. A
+    claim completing in the instant the timer fires can still be discarded
+    (or, on the final attempt, reported as failed); that residual window is
+    milliseconds against a default 900 s timeout.
 
     Re-exec'ing while our own claim RPC is in flight can itself leave a
     stale grant (the very condition that causes these hangs), so a fresh
@@ -156,7 +159,15 @@ class _HangWatchdog:
             delay = min(300.0, backoff_base * (2 ** (self._attempt - 1)))
             log(f"sleeping {delay:.0f}s then re-exec "
                 f"(attempt {self._attempt + 1})")
-            time.sleep(delay)
+        # Sleep OUTSIDE the lock: the in-flight claim may complete during the
+        # backoff — done() must not block on us, and a late success must win
+        # over the re-exec (discarding a fresh grant would leave it stale,
+        # the very condition this watchdog exists to escape).
+        time.sleep(delay)
+        with self._lock:
+            if self._done:
+                log("claim completed during backoff — keeping it, no re-exec")
+                return
             env = dict(os.environ)
             env[_ATTEMPT_ENV] = str(self._attempt + 1)
             env[_ERRLOG_ENV] = _SEP.join(history)[-4000:]

@@ -40,6 +40,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--epochs", type=int, default=10)
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--optimizer", type=str, default="sgd",
+                   choices=["sgd", "adamw"])
+    p.add_argument("--weight_decay", type=float, default=0.0)
+    p.add_argument("--lr_schedule", type=str, default="constant",
+                   choices=["constant", "cosine"],
+                   help="cosine decays to 0 over total_steps (derived from "
+                        "dataset size x epochs unless --total_steps is given)")
+    p.add_argument("--warmup_steps", type=int, default=0,
+                   help="linear lr warmup before the schedule")
+    p.add_argument("--total_steps", type=int, default=None,
+                   help="schedule horizon override")
+    p.add_argument("--grad_clip", type=float, default=0.0,
+                   help=">0: clip gradients by global norm")
+    p.add_argument("--grad_accum", type=int, default=1,
+                   help=">1: accumulate N micro-batches per optimizer update")
     p.add_argument("--num_workers", type=int, default=0)
     p.add_argument("--no_ddp", action="store_true",
                    help="single-device debug mode (reference --no_ddp)")
@@ -55,6 +70,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--producer_threads", type=int, default=4,
                    help="decode-producer threads (cross-batch decode + "
                         "H2D overlap)")
+    p.add_argument("--device_cache", action="store_true",
+                   help="keep epoch-0 batches resident in HBM and replay "
+                        "them in later epochs (no host decode / H2D; "
+                        "augment + MLM masking stay fresh on device)")
+    p.add_argument("--device_cache_gb", type=float, default=8.0,
+                   help="fall back to streaming when the projected resident "
+                        "size exceeds this")
     p.add_argument("--shuffle", action="store_true",
                    help="iterable path: reshuffle batch order every epoch "
                         "(same permutation on every process)")
@@ -74,6 +96,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="GPipe pipeline stages (the 'pipe' mesh axis)")
     p.add_argument("--pp_microbatches", type=int, default=4,
                    help="microbatches per pipeline round")
+    p.add_argument("--fsdp", action="store_true",
+                   help="fully shard params + optimizer state over the "
+                        "'data' axis (ZeRO-3 equivalent)")
     p.add_argument("--num_experts", type=int, default=0,
                    help=">0: switch-MoE transformer blocks; experts shard "
                         "over the 'model' mesh axis (expert parallelism)")
@@ -160,6 +185,14 @@ def main(argv=None) -> dict:
         epochs=args.epochs,
         lr=args.lr,
         momentum=args.momentum,
+        optimizer=args.optimizer,
+        weight_decay=args.weight_decay,
+        lr_schedule=args.lr_schedule,
+        warmup_steps=args.warmup_steps,
+        total_steps=args.total_steps,
+        grad_clip=args.grad_clip,
+        grad_accum=args.grad_accum,
+        fsdp=args.fsdp,
         num_workers=args.num_workers,
         no_ddp=args.no_ddp,
         no_wandb=args.no_wandb,
@@ -169,6 +202,8 @@ def main(argv=None) -> dict:
         vocab_size=args.vocab_size,
         prefetch=args.prefetch,
         producer_threads=args.producer_threads,
+        device_cache=args.device_cache,
+        device_cache_gb=args.device_cache_gb,
         shuffle=args.shuffle,
         augment=not args.no_augment,
         eval_every=args.eval_every,

@@ -140,34 +140,75 @@ def _fit_spec(spec: P, shape: Sequence[int], mesh: Mesh) -> P:
     return P(*out)
 
 
-def partition_specs(tree, rules: Sequence[Tuple[str, P]], mesh: Mesh):
+def _fsdp_spec(shape: Sequence[int], mesh: Mesh, axis: str,
+               min_size: int) -> Optional[P]:
+    """Fully-sharded spec for one leaf: shard its largest ``axis``-divisible
+    dimension over ``axis``; None when the leaf is too small, the axis is
+    absent/trivial, or no dimension divides."""
+    if axis not in mesh.shape or mesh.shape[axis] == 1:
+        return None
+    if not shape or int(np.prod(shape)) < min_size:
+        return None
+    size = mesh.shape[axis]
+    for d in sorted(range(len(shape)), key=lambda i: -shape[i]):
+        if shape[d] % size == 0:
+            out = [None] * (d + 1)
+            out[d] = axis
+            return P(*out)
+    return None
+
+
+def partition_specs(tree, rules: Sequence[Tuple[str, P]], mesh: Mesh, *,
+                    fsdp_axis: Optional[str] = None,
+                    fsdp_min_size: int = 16384):
     """Pytree (arrays or ShapeDtypeStructs) → pytree of PartitionSpec.
 
     Every leaf's path is matched against ``rules`` (``re.search`` on the
     "/"-joined path, so rules anchored with ``$`` match the *tail*); the first
     hit, clamped by :func:`_fit_spec`, wins; no hit → replicated.
+
+    ``fsdp_axis`` turns on ZeRO-3-style fully-sharded data parallelism: any
+    leaf the rules leave fully replicated (including rule hits clamped away on
+    this mesh) instead shards its largest divisible dimension over that axis —
+    params AND optimizer state, since both flow through here. XLA's SPMD
+    partitioner then inserts the per-layer all-gathers (forward/backward) and
+    keeps the optimizer update fully sharded, which is exactly the FSDP
+    memory/communication trade. Leaves smaller than ``fsdp_min_size`` elements
+    (biases, layer norms, batch-norm statistics, step counters) stay
+    replicated — sharding them saves nothing and costs latency-bound
+    collectives.
     """
     compiled = [(re.compile(pat), spec) for pat, spec in rules]
 
     def assign(path, leaf):
         name = _path_str(path)
         shape = getattr(leaf, "shape", ())
-        for pat, spec in compiled:
+        spec = P()
+        for pat, s in compiled:
             if pat.search(name):
-                return _fit_spec(spec, shape, mesh)
-        return P()
+                spec = _fit_spec(s, shape, mesh)
+                break
+        if fsdp_axis is not None and not any(a is not None for a in spec):
+            fs = _fsdp_spec(shape, mesh, fsdp_axis, fsdp_min_size)
+            if fs is not None:
+                return fs
+        return spec
 
     return jax.tree_util.tree_map_with_path(assign, tree)
 
 
-def state_shardings(abstract_state, mesh: Mesh, rules: Sequence[Tuple[str, P]]):
+def state_shardings(abstract_state, mesh: Mesh, rules: Sequence[Tuple[str, P]],
+                    *, fsdp_axis: Optional[str] = None,
+                    fsdp_min_size: int = 16384):
     """NamedSharding tree for a whole TrainState.
 
     Works on ``jax.eval_shape`` output; because the optimizer's momentum/trace
     mirrors the param tree, the same path-tail rules shard it identically —
-    params and their optimizer state are always co-located.
+    params and their optimizer state are always co-located. With ``fsdp_axis``
+    set, both are fully sharded over that axis (see :func:`partition_specs`).
     """
-    specs = partition_specs(abstract_state, rules, mesh)
+    specs = partition_specs(abstract_state, rules, mesh, fsdp_axis=fsdp_axis,
+                            fsdp_min_size=fsdp_min_size)
     return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs)
 
 

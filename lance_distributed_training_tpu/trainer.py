@@ -53,6 +53,8 @@ __all__ = [
     "TrainState",
     "train",
     "create_train_state",
+    "create_sharded_train_state",
+    "make_optimizer",
     "make_train_step",
     "make_eval_step",
     "evaluate",
@@ -83,6 +85,16 @@ class TrainConfig:
     epochs: int = 10
     lr: float = 0.05
     momentum: float = 0.9
+    # -- optimizer/schedule knobs beyond the reference's fixed-lr SGD
+    # (lance_iterable.py:98) --
+    optimizer: str = "sgd"  # sgd | adamw
+    weight_decay: float = 0.0
+    lr_schedule: str = "constant"  # constant | cosine (optional linear warmup)
+    warmup_steps: int = 0
+    total_steps: Optional[int] = None  # schedule horizon; None = derived from
+    # dataset size × epochs at train() time
+    grad_clip: float = 0.0  # >0: clip gradients by global norm
+    grad_accum: int = 1  # >1: accumulate N micro-steps per optimizer update
     num_workers: int = 0  # >0: decode in N worker processes (get_safe_loader parity)
     no_ddp: bool = False  # single-device escape hatch (lance_iterable.py:145)
     no_wandb: bool = False  # lance_iterable.py:146
@@ -93,6 +105,14 @@ class TrainConfig:
     prefetch: int = 2
     producer_threads: int = 4  # decode-producer threads; also pipelines the
     # per-batch H2D copy (expensive on tunneled TPU clients) across threads
+    device_cache: bool = False  # HBM-resident dataset: keep epoch-0 batches
+    # on device and replay them in later epochs — no host decode, no H2D.
+    # Correct for every task here because augmentation / MLM masking run ON
+    # DEVICE inside the jitted step (fresh randomness each epoch); the cache
+    # holds raw uint8/token batches. Epoch shuffle degrades to batch-order
+    # permutation (membership frozen at epoch 0).
+    device_cache_gb: float = 8.0  # projected-size guard: fall back to the
+    # streaming path (with a warning) when the dataset won't fit
     shuffle: bool = False  # iterable path: epoch batch-order reshuffle
     # (beyond the reference — Lance samplers replay the same order every
     # epoch; map-style shuffles regardless, as DistributedSampler does)
@@ -111,6 +131,8 @@ class TrainConfig:
     moe_every: int = 2  # MoE on every Nth block
     pipeline_parallelism: int = 1  # GPipe stages over a 'pipe' mesh axis
     pp_microbatches: int = 4  # microbatches per pipeline round
+    fsdp: bool = False  # ZeRO-3-style: fully shard params + optimizer state
+    # over the 'data' axis; XLA inserts the per-layer all-gathers
     # -- aux subsystems the reference lacks (SURVEY.md §5) --
     checkpoint_dir: Optional[str] = None  # orbax save/restore root
     checkpoint_every: int = 1  # save every N epochs
@@ -177,9 +199,62 @@ def _task_from_config(config: TrainConfig, mesh=None) -> Task:
     )
 
 
-def create_train_state(rng: jax.Array, task: Task, config: TrainConfig) -> TrainState:
+def make_optimizer(config: TrainConfig, total_steps: Optional[int] = None):
+    """Optax chain from the config knobs.
+
+    The reference trains with a single fixed-lr SGD
+    (``/root/reference/lance_iterable.py:98``); that stays the default. Beyond
+    it: AdamW (decoupled weight decay), SGD + classic L2 weight decay (the
+    decay term rides the momentum buffer, torch ``SGD(weight_decay=)``
+    semantics), cosine decay with linear warmup, global-norm gradient
+    clipping, and gradient accumulation (``optax.MultiSteps`` — N
+    micro-batches per parameter update, the memory-for-batch-size trade that
+    needs no loader change).
+
+    ``total_steps`` / ``warmup_steps`` are counted in *data* (micro) steps;
+    with ``grad_accum > 1`` they are converted to optimizer updates here,
+    since ``MultiSteps`` advances the inner schedule once per accumulation
+    window — otherwise the schedule would traverse only 1/N of its horizon.
+    """
+    horizon = total_steps or config.total_steps
+    accum = max(config.grad_accum, 1)
+    if config.lr_schedule == "constant":
+        lr = config.lr
+    elif config.lr_schedule == "cosine":
+        if not horizon:
+            raise ValueError("cosine schedule needs total_steps")
+        horizon = max(-(-horizon // accum), 1)
+        warmup = -(-config.warmup_steps // accum)
+        if warmup > 0:
+            lr = optax.warmup_cosine_decay_schedule(
+                0.0, config.lr, warmup, max(horizon, warmup + 1)
+            )
+        else:
+            lr = optax.cosine_decay_schedule(config.lr, horizon)
+    else:
+        raise ValueError(f"Invalid lr_schedule: {config.lr_schedule}")
+
+    parts = []
+    if config.grad_clip > 0:
+        parts.append(optax.clip_by_global_norm(config.grad_clip))
+    if config.optimizer == "sgd":
+        if config.weight_decay > 0:
+            parts.append(optax.add_decayed_weights(config.weight_decay))
+        parts.append(optax.sgd(lr, momentum=config.momentum))
+    elif config.optimizer == "adamw":
+        parts.append(optax.adamw(lr, weight_decay=config.weight_decay))
+    else:
+        raise ValueError(f"Invalid optimizer: {config.optimizer}")
+    tx = parts[0] if len(parts) == 1 else optax.chain(*parts)
+    if config.grad_accum > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=config.grad_accum)
+    return tx
+
+
+def create_train_state(rng: jax.Array, task: Task, config: TrainConfig,
+                       total_steps: Optional[int] = None) -> TrainState:
     variables = task.init_variables(rng)
-    tx = optax.sgd(config.lr, momentum=config.momentum)
+    tx = make_optimizer(config, total_steps)
     return TrainState.create(
         apply_fn=None,
         params=variables["params"],
@@ -189,21 +264,24 @@ def create_train_state(rng: jax.Array, task: Task, config: TrainConfig) -> Train
 
 
 def create_sharded_train_state(
-    rng: jax.Array, task: Task, config: TrainConfig, mesh, rules=()
+    rng: jax.Array, task: Task, config: TrainConfig, mesh, rules=(),
+    *, fsdp_axis: Optional[str] = None, total_steps: Optional[int] = None,
 ):
     """Initialize the TrainState *directly sharded* over the mesh.
 
     Init runs under jit with ``out_shardings`` derived from the partition
     rules, so each device materialises only its parameter shard — no host
     round-trip, no full replica anywhere (how a model larger than one chip's
-    HBM gets initialized). Returns ``(state, sharding_pytree)``.
+    HBM gets initialized). With ``fsdp_axis``, rule-unmatched leaves (params
+    AND their optimizer state) fully shard over that axis instead of
+    replicating. Returns ``(state, sharding_pytree)``.
     """
     from .parallel.sharding import state_shardings
 
     # One tx instance shared by the eval_shape pass and the jitted init —
     # TrainState's static metadata (tx, apply_fn) must be identical in the
     # out_shardings prefix tree and the actual output.
-    tx = optax.sgd(config.lr, momentum=config.momentum)
+    tx = make_optimizer(config, total_steps)
 
     def _create(r):
         variables = task.init_variables(r)
@@ -215,7 +293,7 @@ def create_sharded_train_state(
         )
 
     abstract = jax.eval_shape(_create, rng)
-    shardings = state_shardings(abstract, mesh, rules)
+    shardings = state_shardings(abstract, mesh, rules, fsdp_axis=fsdp_axis)
     return jax.jit(_create, out_shardings=shardings)(rng), shardings
 
 
@@ -470,8 +548,21 @@ def train(config: TrainConfig) -> dict:
         if (config.model_parallelism > 1 or config.pipeline_parallelism > 1)
         else ()
     )
+    total_steps = config.total_steps
+    if total_steps is None and config.lr_schedule != "constant":
+        # Schedule horizon: steps/epoch × epochs. count_rows // batch matches
+        # the balanced samplers' drop-last behaviour closely enough for a
+        # decay horizon (fragment padding can add a few steps).
+        if dataset is not None:
+            rows = dataset.count_rows()
+        else:
+            from .data.authoring import _folder_samples
+
+            rows = len(_folder_samples(config.dataset_path)[0])
+        total_steps = max(rows // config.batch_size, 1) * config.epochs
     state, state_sharding = create_sharded_train_state(
-        init_rng, task, config, mesh, rules
+        init_rng, task, config, mesh, rules,
+        fsdp_axis="data" if config.fsdp else None, total_steps=total_steps,
     )
     batch_spec = (
         batch_partition_spec(2, seq_axis="seq")
@@ -543,12 +634,31 @@ def train(config: TrainConfig) -> dict:
 def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 eval_step, logger, timer, worker_pool, ckpt, start_epoch,
                 total_start, n_devices, results, global_step, profiling):
+    # HBM-resident dataset cache (--device_cache): filled on the first
+    # executed epoch, replayed afterwards. See TrainConfig.device_cache.
+    cache: list = []
+    cache_ok = config.device_cache
+    history: list = []  # per-epoch metrics, returned as results["history"]
     for epoch in range(start_epoch, config.epochs):
-        loader = _build_loader(config, dataset, mesh, epoch, worker_pool)
+        replay = cache_ok and epoch > start_epoch and len(cache) > 0
+        if replay:
+            if config.shuffle or config.loader_style == "map":
+                import numpy as _np
+
+                order = _np.random.default_rng(
+                    config.seed + epoch
+                ).permutation(len(cache))
+                it = iter([cache[i] for i in order])
+            else:
+                it = iter(list(cache))
+            loader = None
+        else:
+            loader = _build_loader(config, dataset, mesh, epoch, worker_pool)
+            it = iter(loader)
+        filling = cache_ok and not replay
         timer.reset()
         epoch_start = time.perf_counter()
         loss_sum = jnp.zeros((), jnp.float32)  # stays on device all epoch
-        it = iter(loader)
         epoch_step = 0
         while True:
             timer.loader_start()
@@ -556,6 +666,26 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             timer.loader_stop()
             if batch is None:
                 break
+            if filling:
+                if not cache:
+                    per_batch = sum(
+                        leaf.nbytes
+                        for leaf in jax.tree_util.tree_leaves(batch)
+                    )
+                    projected = per_batch * len(loader)
+                    if projected > config.device_cache_gb * 1e9:
+                        cache_ok = False
+                        filling = False
+                        logger.log(
+                            {
+                                "device_cache": "disabled",
+                                "projected_gb": round(projected / 1e9, 2),
+                                "limit_gb": config.device_cache_gb,
+                            },
+                            to_wandb=False,
+                        )
+                if filling:
+                    cache.append(batch)
             if (
                 config.profile_dir
                 and epoch == start_epoch
@@ -649,10 +779,12 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             )
             epoch_metrics["val_acc"] = evaluate(state, val_loader, eval_step)
         logger.log(epoch_metrics, step=epoch)
+        history.append(dict(epoch_metrics))
         results = epoch_metrics
         if ckpt is not None and (epoch + 1) % config.checkpoint_every == 0:
             ckpt.save(epoch + 1, state)
 
+    results["history"] = history
     results["total_time"] = time.perf_counter() - total_start
     results["start_epoch"] = start_epoch
     if config.eval_at_end:
