@@ -257,6 +257,19 @@ def _run(jax, devices) -> dict:
     # sustains. The cold first-epoch rate and its stall share are reported
     # alongside, not hidden: on this box the first epoch is bound by tunnel
     # H2D + host decode, and the fields below say so.
+    # HBM accounting (supported on TPU; absent on CPU backends): shows the
+    # headroom the --device_cache mode has for real datasets.
+    mem = {}
+    try:
+        stats = devices[0].memory_stats() or {}
+        for k_src, k_out in (("bytes_in_use", "hbm_bytes_in_use"),
+                             ("peak_bytes_in_use", "hbm_peak_bytes_in_use"),
+                             ("bytes_limit", "hbm_bytes_limit")):
+            if k_src in stats:
+                mem[k_out] = int(stats[k_src])
+    except Exception:
+        pass
+
     result = {
         "metric": METRIC,
         "value": round(cached_per_chip, 2),
@@ -301,6 +314,7 @@ def _run(jax, devices) -> dict:
         "measured_steps": measure,
         "wall_s": round(wall, 3),
         "cached_wall_s": round(cached_wall, 3),
+        **mem,
     }
     if trace:
         result["trace_dir"] = trace_dir

@@ -32,6 +32,10 @@ def build_parser() -> argparse.ArgumentParser:
                             "sharded_batch", "sharded_fragment", "full_scan"])
     p.add_argument("--loader_style", type=str, default="iterable",
                    choices=["iterable", "map"])
+    p.add_argument("--filter", type=str, default=None,
+                   help="row predicate, e.g. \"label < 50\" or "
+                        "\"label >= 10 & label != 13\" (map-style columnar "
+                        "path; resolved to an index pool once)")
     p.add_argument("--data_format", type=str, default="columnar",
                    choices=["columnar", "folder"],
                    help="folder = the file-reading control arm (torch_version/)")
@@ -180,6 +184,7 @@ def main(argv=None) -> dict:
         num_classes=args.num_classes,
         sampler_type=args.sampler_type,
         loader_style=args.loader_style,
+        filter=args.filter,
         data_format=args.data_format,
         batch_size=args.batch_size,
         epochs=args.epochs,

@@ -30,5 +30,6 @@ from .authoring import (  # noqa: F401
     create_text_token_dataset,
     ingest_on_process_zero,
 )
+from .filters import parse_predicate, predicate_mask  # noqa: F401
 from .folder import FolderDataPipeline  # noqa: F401
 from .workers import WorkerPool, columnar_spec, folder_spec  # noqa: F401

@@ -117,6 +117,21 @@ class _FragmentReader:
             pieces.append(batch.slice(lo, hi - lo))
         return pa.Table.from_batches(pieces, schema=self._reader.schema)
 
+    def table(self) -> pa.Table:
+        """The whole fragment as a table of zero-copy views (cached: the
+        batches alias the memory map, so the cache holds only metadata —
+        rebuilding per call cost per-batch metadata work every map-style
+        step)."""
+        if self._table is None:
+            self._table = pa.Table.from_batches(
+                [
+                    self._reader.get_batch(i)
+                    for i in range(self._reader.num_record_batches)
+                ],
+                schema=self._reader.schema,
+            )
+        return self._table
+
     def take(
         self,
         indices: Sequence[int],
@@ -126,18 +141,9 @@ class _FragmentReader:
         ``columns`` projects BEFORE the gather (``select`` is a zero-copy
         view; ``take`` copies values) so unused columns are never
         materialised."""
-        if self._table is None:
-            # Assemble once per reader: the batches are zero-copy views into
-            # the memory map, so this caches only metadata — rebuilding it per
-            # take() call cost per-batch metadata work every map-style step.
-            self._table = pa.Table.from_batches(
-                [
-                    self._reader.get_batch(i)
-                    for i in range(self._reader.num_record_batches)
-                ],
-                schema=self._reader.schema,
-            )
-        table = self._table if columns is None else self._table.select(columns)
+        table = self.table()
+        if columns is not None:
+            table = table.select(columns)
         return table.take(pa.array(np.asarray(indices, dtype=np.int64)))
 
 
@@ -292,6 +298,29 @@ class Dataset:
 
     def take_batch(self, indices: Sequence[int]) -> pa.RecordBatch:
         return self.take(indices).combine_chunks().to_batches()[0]
+
+    def filter_indices(self, predicate) -> np.ndarray:
+        """Global row indices satisfying ``predicate``, ascending.
+
+        ``predicate`` is a string in the mini-grammar (``"label < 50"``), a
+        pyarrow compute Expression, or a callable ``table -> bool mask`` —
+        see :mod:`.filters`. The upstream Lance scanner's row-filter
+        capability, resolved once to an index pool; training then deals
+        batches from the pool (map-style path), preserving the samplers'
+        equal-step guarantees.
+        """
+        from .filters import predicate_mask
+
+        out = []
+        for fid in range(len(self.fragments)):
+            mask = predicate_mask(self._reader(fid).table(), predicate)
+            (local,) = np.nonzero(mask)
+            out.append(local + self._row_offsets[fid])
+        return (
+            np.concatenate(out).astype(np.int64)
+            if out
+            else np.empty(0, np.int64)
+        )
 
 
 def _iter_record_batches(

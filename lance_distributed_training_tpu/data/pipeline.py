@@ -350,6 +350,7 @@ class MapStylePipeline:
         workers=None,
         producers: int = 1,
         columns: Optional[Sequence[str]] = None,
+        index_pool: Optional[np.ndarray] = None,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -365,13 +366,23 @@ class MapStylePipeline:
         self.workers = workers
         self.producers = producers
         self.columns = list(columns) if columns is not None else None
+        # Optional row-filter pool (Dataset.filter_indices): shard/permute
+        # POSITIONS in the pool, then map back to global rows — every process
+        # derives the same pool, so the equal-step invariant holds unchanged.
+        self.index_pool = (
+            np.asarray(index_pool, dtype=np.int64)
+            if index_pool is not None
+            else None
+        )
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
 
     def _index_batches(self) -> list[np.ndarray]:
-        return distributed_index_batches(
-            self.dataset.count_rows(),
+        pool = self.index_pool
+        n = self.dataset.count_rows() if pool is None else len(pool)
+        batches = distributed_index_batches(
+            n,
             self.batch_size,
             self.process_index,
             self.process_count,
@@ -380,6 +391,9 @@ class MapStylePipeline:
             epoch=self.epoch,
             drop_last=self.drop_last,
         )
+        if pool is not None:
+            batches = [pool[b] for b in batches]
+        return batches
 
     def __len__(self) -> int:
         return len(self._index_batches())

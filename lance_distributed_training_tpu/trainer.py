@@ -80,6 +80,8 @@ class TrainConfig:
     num_classes: int = 101
     sampler_type: str = "batch"  # batch | fragment | full (lance_iterable.py:61-69)
     loader_style: str = "iterable"  # iterable | map  (the two reference paths)
+    filter: Optional[str] = None  # row predicate ("label < 50"), resolved to
+    # an index pool once; map-style columnar path only (see data/filters.py)
     data_format: str = "columnar"  # columnar | folder (the torch_version/ control arm)
     batch_size: int = 512  # GLOBAL batch (reference default, lance_iterable.py:141)
     epochs: int = 10
@@ -219,7 +221,14 @@ def make_optimizer(config: TrainConfig, total_steps: Optional[int] = None):
     horizon = total_steps or config.total_steps
     accum = max(config.grad_accum, 1)
     if config.lr_schedule == "constant":
-        lr = config.lr
+        if config.warmup_steps > 0:
+            # Linear warmup, then constant — warmup_steps must never be a
+            # silent no-op just because no decay schedule was chosen.
+            lr = optax.linear_schedule(
+                0.0, config.lr, max(-(-config.warmup_steps // accum), 1)
+            )
+        else:
+            lr = config.lr
     elif config.lr_schedule == "cosine":
         if not horizon:
             raise ValueError("cosine schedule needs total_steps")
@@ -429,7 +438,7 @@ def _make_worker_pool(config: TrainConfig, dataset):
 
 
 def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
-                  workers=None):
+                  workers=None, index_pool=None):
     process_index, process_count = process_topology()
     per_process = config.batch_size // process_count
     if per_process * process_count != config.batch_size:
@@ -443,6 +452,9 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
         mesh=mesh,
         seq_axis="seq" if config.seq_parallelism > 1 else None,
     )
+    if config.filter and config.data_format != "columnar":
+        raise ValueError("filter= needs the columnar store (data_format="
+                         "'columnar'); folder trees have no row predicates")
     if config.data_format == "folder":
         # Control arm: plain files, no columnar store (torch_version/ twin,
         # reference README.md:286-290).
@@ -474,7 +486,22 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             )
         return loader
     columns = getattr(decode, "required_columns", None)
+    if config.filter and config.loader_style != "map":
+        raise ValueError(
+            "filter= needs the map-style loader (the predicate resolves to "
+            "an index pool; iterable range plans read contiguous rows); pass "
+            "loader_style='map'"
+        )
     if config.loader_style == "map":
+        if config.filter and index_pool is None:
+            # Fallback for direct calls / held-out val datasets; train()
+            # resolves the TRAIN pool once and passes it down.
+            index_pool = dataset.filter_indices(config.filter)
+        if index_pool is not None and len(index_pool) < config.batch_size:
+            raise ValueError(
+                f"filter {config.filter!r} keeps {len(index_pool)} rows — "
+                f"fewer than one global batch ({config.batch_size})"
+            )
         loader = MapStylePipeline(
             dataset,
             per_process,
@@ -488,6 +515,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             workers=workers,
             producers=config.producer_threads,
             columns=columns,
+            index_pool=index_pool,
         )
     else:
         loader = make_train_pipeline(
@@ -548,12 +576,25 @@ def train(config: TrainConfig) -> dict:
         if (config.model_parallelism > 1 or config.pipeline_parallelism > 1)
         else ()
     )
+    # Row-filter pool: resolved ONCE here (deterministic; per-epoch
+    # re-resolution would rescan every fragment at each epoch/eval boundary)
+    # and passed down to every train-side loader build.
+    index_pool = None
+    if (
+        config.filter
+        and config.data_format == "columnar"
+        and config.loader_style == "map"
+    ):
+        index_pool = dataset.filter_indices(config.filter)
     total_steps = config.total_steps
     if total_steps is None and config.lr_schedule != "constant":
-        # Schedule horizon: steps/epoch × epochs. count_rows // batch matches
-        # the balanced samplers' drop-last behaviour closely enough for a
-        # decay horizon (fragment padding can add a few steps).
-        if dataset is not None:
+        # Schedule horizon: steps/epoch × epochs. rows // batch matches the
+        # balanced samplers' drop-last behaviour closely enough for a decay
+        # horizon (fragment padding can add a few steps). A --filter pool
+        # shrinks the horizon with it.
+        if index_pool is not None:
+            rows = len(index_pool)
+        elif dataset is not None:
             rows = dataset.count_rows()
         else:
             from .data.authoring import _folder_samples
@@ -617,6 +658,7 @@ def train(config: TrainConfig) -> dict:
             config, dataset, val_dataset, mesh, state, rng, train_step,
             eval_step, logger, timer, worker_pool, ckpt, start_epoch,
             total_start, n_devices, results, global_step, profiling,
+            index_pool,
         )
     finally:
         if config.profile_dir:
@@ -633,7 +675,8 @@ def train(config: TrainConfig) -> dict:
 
 def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 eval_step, logger, timer, worker_pool, ckpt, start_epoch,
-                total_start, n_devices, results, global_step, profiling):
+                total_start, n_devices, results, global_step, profiling,
+                index_pool=None):
     # HBM-resident dataset cache (--device_cache): filled on the first
     # executed epoch, replayed afterwards. See TrainConfig.device_cache.
     cache: list = []
@@ -653,7 +696,8 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 it = iter(list(cache))
             loader = None
         else:
-            loader = _build_loader(config, dataset, mesh, epoch, worker_pool)
+            loader = _build_loader(config, dataset, mesh, epoch, worker_pool,
+                                   index_pool=index_pool)
             it = iter(loader)
         filling = cache_ok and not replay
         timer.reset()
@@ -776,6 +820,9 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 mesh,
                 epoch,
                 worker_pool if val_dataset is None else None,
+                # A held-out val dataset resolves its OWN pool (fallback in
+                # _build_loader); eval over the train loader reuses the pool.
+                index_pool=index_pool if val_dataset is None else None,
             )
             epoch_metrics["val_acc"] = evaluate(state, val_loader, eval_step)
         logger.log(epoch_metrics, step=epoch)
@@ -798,6 +845,7 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             mesh,
             0,
             worker_pool if val_dataset is None else None,
+            index_pool=index_pool if val_dataset is None else None,
         )
         results[key] = evaluate(state, loader, eval_step)
         logger.log({key: results[key]})

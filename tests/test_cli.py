@@ -55,3 +55,30 @@ def test_cli_flag_plumbing(monkeypatch):
     assert config.shuffle is True
     assert config.producer_threads == 3
     assert config.batch_size == 64
+
+
+def test_cli_optimizer_and_cache_flags(monkeypatch):
+    """The round-3 knobs reach TrainConfig: optimizer/schedule/accum, fsdp,
+    and the HBM-resident dataset cache."""
+    captured = {}
+    monkeypatch.setattr(
+        cli, "train", lambda config: captured.update(config=config) or {}
+    )
+    cli.main([
+        "--dataset_path", "/d", "--no_wandb",
+        "--optimizer", "adamw", "--weight_decay", "0.01",
+        "--lr_schedule", "cosine", "--warmup_steps", "7",
+        "--total_steps", "1234", "--grad_clip", "0.5", "--grad_accum", "4",
+        "--fsdp", "--device_cache", "--device_cache_gb", "2.5",
+    ])
+    config = captured["config"]
+    assert config.optimizer == "adamw"
+    assert config.weight_decay == 0.01
+    assert config.lr_schedule == "cosine"
+    assert config.warmup_steps == 7
+    assert config.total_steps == 1234
+    assert config.grad_clip == 0.5
+    assert config.grad_accum == 4
+    assert config.fsdp is True
+    assert config.device_cache is True
+    assert config.device_cache_gb == 2.5

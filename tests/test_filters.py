@@ -1,0 +1,177 @@
+"""Row-filter predicates: parser, Dataset.filter_indices, and filtered
+map-style training (the upstream Lance scanner's row-filter capability,
+resolved to an index pool so the distributed samplers' equal-step guarantees
+hold unchanged)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pytest
+
+from lance_distributed_training_tpu.data import (
+    MapStylePipeline,
+    parse_predicate,
+    predicate_mask,
+    write_dataset,
+)
+
+
+@pytest.fixture()
+def labeled_dataset(tmp_path):
+    table = pa.table(
+        {
+            "x": pa.array(np.arange(100, dtype=np.float32)),
+            "label": pa.array(np.arange(100, dtype=np.int64) % 10),
+        }
+    )
+    return write_dataset(table, tmp_path / "ds", max_rows_per_file=30)
+
+
+# ---------------------------------------------------------------- parser
+def test_parse_predicate_grammar():
+    table = pa.table({"label": pa.array([1, 5, 13, 50], pa.int64())})
+    mask = predicate_mask(table, "label < 50")
+    assert mask.tolist() == [True, True, True, False]
+    mask = predicate_mask(table, "label >= 5 & label != 13")
+    assert mask.tolist() == [False, True, False, True]
+    with pytest.raises(ValueError, match="bad predicate term"):
+        parse_predicate("label ~ 3")
+    with pytest.raises(ValueError, match="unparseable literal"):
+        parse_predicate("label == three")
+    with pytest.raises(ValueError, match="empty predicate"):
+        parse_predicate("  ")
+
+
+def test_predicate_forms_agree(labeled_dataset):
+    """String, Expression, and callable forms select identical rows."""
+    by_str = labeled_dataset.filter_indices("label < 3")
+    by_expr = labeled_dataset.filter_indices(pc.field("label") < 3)
+    by_call = labeled_dataset.filter_indices(
+        lambda t: t.column("label").to_numpy() < 3
+    )
+    np.testing.assert_array_equal(by_str, by_expr)
+    np.testing.assert_array_equal(by_str, by_call)
+    # Rows 0..99 with label = idx % 10 → labels 0,1,2 ⇒ 30 rows, ascending.
+    assert len(by_str) == 30
+    assert (np.sort(by_str) == by_str).all()
+    labels = labeled_dataset.take(by_str).column("label").to_numpy()
+    assert (labels < 3).all()
+
+
+# ---------------------------------------------------------------- pipeline
+def test_map_style_pipeline_respects_pool(labeled_dataset):
+    pool = labeled_dataset.filter_indices("label >= 8")  # 20 rows
+    pipe = MapStylePipeline(
+        labeled_dataset, 8, 0, 1,
+        decode_fn=lambda t: {"label": t.column("label").to_numpy()},
+        shuffle=True, seed=3, index_pool=pool,
+    )
+    assert len(pipe) == 2  # 20 // 8, drop_last
+    seen = np.concatenate([b["label"] for b in pipe])
+    assert (seen >= 8).all()
+    # Disjoint sharding inside the pool across 2 simulated processes.
+    shards = []
+    for p in range(2):
+        pp = MapStylePipeline(
+            labeled_dataset, 4, p, 2,
+            decode_fn=lambda t: {"i": t.column("x").to_numpy()},
+            shuffle=True, seed=3, index_pool=pool,
+        )
+        shards.append(np.concatenate([b["i"] for b in pp]))
+    assert not set(shards[0]) & set(shards[1])
+
+
+# ---------------------------------------------------------------- trainer
+def test_train_with_filter(image_dataset):
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    cfg = TrainConfig(
+        dataset_path=image_dataset.uri,
+        num_classes=10,
+        model_name="resnet18",
+        image_size=32,
+        batch_size=16,
+        epochs=1,
+        no_wandb=True,
+        augment=False,
+        eval_at_end=False,
+        loader_style="map",
+        filter="label < 5",
+    )
+    results = train(cfg)
+    assert np.isfinite(results["loss"])
+
+
+def test_filter_pool_resolved_once(image_dataset, monkeypatch):
+    """The deterministic pool is resolved once in train(), not per epoch."""
+    from lance_distributed_training_tpu.data.format import Dataset
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    calls = {"n": 0}
+    original = Dataset.filter_indices
+
+    def counting(self, predicate):
+        calls["n"] += 1
+        return original(self, predicate)
+
+    monkeypatch.setattr(Dataset, "filter_indices", counting)
+    cfg = TrainConfig(
+        dataset_path=image_dataset.uri, num_classes=10, model_name="resnet18",
+        image_size=32, batch_size=16, epochs=2, no_wandb=True, augment=False,
+        eval_at_end=True, loader_style="map", filter="label < 5",
+    )
+    results = train(cfg)
+    assert np.isfinite(results["loss"])
+    assert calls["n"] == 1
+
+
+def test_filter_shrinks_cosine_horizon(image_dataset):
+    """With a filter pool, the derived schedule horizon uses the pool size,
+    not the full dataset."""
+    import lance_distributed_training_tpu.trainer as trainer_mod
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    seen = {}
+    original = trainer_mod.create_sharded_train_state
+
+    def capture(rng, task, config, mesh, rules=(), **kw):
+        seen["total_steps"] = kw.get("total_steps")
+        return original(rng, task, config, mesh, rules, **kw)
+
+    trainer_mod.create_sharded_train_state = capture
+    try:
+        cfg = TrainConfig(
+            dataset_path=image_dataset.uri, num_classes=10,
+            model_name="resnet18", image_size=32, batch_size=16, epochs=2,
+            no_wandb=True, augment=False, eval_at_end=False,
+            loader_style="map", filter="label < 5", lr_schedule="cosine",
+        )
+        train(cfg)
+    finally:
+        trainer_mod.create_sharded_train_state = original
+    pool = len(trainer_mod.Dataset(image_dataset.uri).filter_indices("label < 5"))
+    assert seen["total_steps"] == max(pool // 16, 1) * 2
+
+
+def test_filter_requires_map_style(image_dataset):
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    cfg = TrainConfig(
+        dataset_path=image_dataset.uri, num_classes=10, model_name="resnet18",
+        image_size=32, batch_size=16, epochs=1, no_wandb=True,
+        eval_at_end=False, filter="label < 5",
+    )
+    with pytest.raises(ValueError, match="map-style"):
+        train(cfg)
+
+
+def test_filter_smaller_than_batch_raises(image_dataset):
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    cfg = TrainConfig(
+        dataset_path=image_dataset.uri, num_classes=10, model_name="resnet18",
+        image_size=32, batch_size=200, epochs=1, no_wandb=True,
+        eval_at_end=False, loader_style="map", filter="label == 3",
+    )
+    with pytest.raises(ValueError, match="fewer than one global batch"):
+        train(cfg)

@@ -51,6 +51,20 @@ def test_schedule_values():
     assert state is not None  # tx builds and inits
 
 
+def test_warmup_applies_to_constant_schedule():
+    """--warmup_steps without a decay schedule must warm up, not no-op."""
+    cfg = _cfg(lr=1.0, momentum=0.0, warmup_steps=4)
+    tx = make_optimizer(cfg)
+    params = {"w": jnp.array([0.0])}
+    state = tx.init(params)
+    g = {"w": jnp.array([1.0])}
+    up0, state = tx.update(g, state, params)
+    assert abs(float(up0["w"][0])) < 1e-6  # step 0: lr ≈ 0
+    for _ in range(5):
+        up, state = tx.update(g, state, params)
+    assert float(up["w"][0]) == pytest.approx(-1.0)  # post-warmup: constant lr
+
+
 def test_cosine_horizon_converts_microsteps_under_accum():
     """total_steps is counted in data (micro) steps; MultiSteps advances the
     inner schedule once per accumulation window, so the horizon must shrink
