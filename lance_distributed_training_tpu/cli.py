@@ -90,6 +90,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--run_name", type=str, default=None)
     p.add_argument("--log_every", type=int, default=50,
                    help="per-step progress line every N steps (0 = off)")
+    p.add_argument("--log_grad_norm", action="store_true",
+                   help="include the micro-batch global gradient norm in "
+                        "per-step progress lines (with --grad_accum the "
+                        "optimizer clips the accumulated mean, which is "
+                        "smoother than this per-micro-batch value)")
     p.add_argument("--model_parallelism", type=int, default=1,
                    help="tensor-parallel degree (the 'model' mesh axis)")
     p.add_argument("--seq_parallelism", type=int, default=1,
@@ -215,6 +220,7 @@ def main(argv=None) -> dict:
         seed=args.seed,
         run_name=args.run_name,
         log_every=args.log_every,
+        log_grad_norm=args.log_grad_norm,
         model_parallelism=args.model_parallelism,
         seq_parallelism=args.seq_parallelism,
         remat=args.remat,

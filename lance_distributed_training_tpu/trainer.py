@@ -55,6 +55,7 @@ __all__ = [
     "create_train_state",
     "create_sharded_train_state",
     "make_optimizer",
+    "lr_schedule_fn",
     "make_train_step",
     "make_eval_step",
     "evaluate",
@@ -124,6 +125,9 @@ class TrainConfig:
     seed: int = 0
     run_name: Optional[str] = None
     log_every: int = 50
+    log_grad_norm: bool = False  # per-step micro-batch global gradient norm
+    # in the progress lines (divergence telemetry; a few fused reductions;
+    # under grad_accum the optimizer clips the accumulated mean, not this)
     # -- parallelism beyond the reference's DP-only scope (SURVEY.md §2.3) --
     model_parallelism: int = 1  # tensor-parallel degree ('model' mesh axis)
     seq_parallelism: int = 1  # context-parallel degree ('seq' axis, ring attn)
@@ -201,6 +205,34 @@ def _task_from_config(config: TrainConfig, mesh=None) -> Task:
     )
 
 
+def lr_schedule_fn(config: TrainConfig, total_steps: Optional[int] = None):
+    """The learning-rate schedule from the config knobs: a float (constant)
+    or an ``optax`` schedule callable over OPTIMIZER updates (data steps are
+    converted under ``grad_accum`` — see :func:`make_optimizer`). Shared by
+    the optimizer build and the per-step lr logging."""
+    horizon = total_steps or config.total_steps
+    accum = max(config.grad_accum, 1)
+    if config.lr_schedule == "constant":
+        if config.warmup_steps > 0:
+            # Linear warmup, then constant — warmup_steps must never be a
+            # silent no-op just because no decay schedule was chosen.
+            return optax.linear_schedule(
+                0.0, config.lr, max(-(-config.warmup_steps // accum), 1)
+            )
+        return config.lr
+    if config.lr_schedule == "cosine":
+        if not horizon:
+            raise ValueError("cosine schedule needs total_steps")
+        horizon = max(-(-horizon // accum), 1)
+        warmup = -(-config.warmup_steps // accum)
+        if warmup > 0:
+            return optax.warmup_cosine_decay_schedule(
+                0.0, config.lr, warmup, max(horizon, warmup + 1)
+            )
+        return optax.cosine_decay_schedule(config.lr, horizon)
+    raise ValueError(f"Invalid lr_schedule: {config.lr_schedule}")
+
+
 def make_optimizer(config: TrainConfig, total_steps: Optional[int] = None):
     """Optax chain from the config knobs.
 
@@ -218,31 +250,7 @@ def make_optimizer(config: TrainConfig, total_steps: Optional[int] = None):
     since ``MultiSteps`` advances the inner schedule once per accumulation
     window — otherwise the schedule would traverse only 1/N of its horizon.
     """
-    horizon = total_steps or config.total_steps
-    accum = max(config.grad_accum, 1)
-    if config.lr_schedule == "constant":
-        if config.warmup_steps > 0:
-            # Linear warmup, then constant — warmup_steps must never be a
-            # silent no-op just because no decay schedule was chosen.
-            lr = optax.linear_schedule(
-                0.0, config.lr, max(-(-config.warmup_steps // accum), 1)
-            )
-        else:
-            lr = config.lr
-    elif config.lr_schedule == "cosine":
-        if not horizon:
-            raise ValueError("cosine schedule needs total_steps")
-        horizon = max(-(-horizon // accum), 1)
-        warmup = -(-config.warmup_steps // accum)
-        if warmup > 0:
-            lr = optax.warmup_cosine_decay_schedule(
-                0.0, config.lr, warmup, max(horizon, warmup + 1)
-            )
-        else:
-            lr = optax.cosine_decay_schedule(config.lr, horizon)
-    else:
-        raise ValueError(f"Invalid lr_schedule: {config.lr_schedule}")
-
+    lr = lr_schedule_fn(config, total_steps)
     parts = []
     if config.grad_clip > 0:
         parts.append(optax.clip_by_global_norm(config.grad_clip))
@@ -314,7 +322,8 @@ def _variables(state: TrainState) -> dict:
 
 
 def make_train_step(task: Task, mesh, *, donate: bool = True,
-                    state_sharding=None, batch_spec=None):
+                    state_sharding=None, batch_spec=None,
+                    grad_norm: bool = False):
     """Build the jitted sharded train step.
 
     Pure DP (the reference's scope): state replicated (``P()``), every batch
@@ -343,6 +352,13 @@ def make_train_step(task: Task, mesh, *, donate: bool = True,
         state = state.apply_gradients(grads=grads)
         if new_model_state is not None and "batch_stats" in new_model_state:
             state = state.replace(batch_stats=new_model_state["batch_stats"])
+        if grad_norm:
+            # Global norm of THIS micro-batch's gradient (a few extra sum-
+            # reductions XLA fuses into the backward) — divergence telemetry
+            # (--log_grad_norm). With grad_accum > 1 the optimizer clips the
+            # accumulated MEAN inside MultiSteps (smoother than this), which
+            # is not observable from here.
+            return state, loss, optax.global_norm(grads)
         return state, loss
 
     repl = replicated_sharding(mesh)
@@ -353,10 +369,11 @@ def make_train_step(task: Task, mesh, *, donate: bool = True,
         data = NamedSharding(mesh, batch_spec)
     else:
         data = batch_sharding(mesh)
+    out_sh = (state_sh, repl, repl) if grad_norm else (state_sh, repl)
     return jax.jit(
         step,
         in_shardings=(state_sh, data, repl),
-        out_shardings=(state_sh, repl),
+        out_shardings=out_sh,
         donate_argnums=(0,) if donate else (),
     )
 
@@ -612,7 +629,8 @@ def train(config: TrainConfig) -> dict:
     )
 
     train_step = make_train_step(
-        task, mesh, state_sharding=state_sharding, batch_spec=batch_spec
+        task, mesh, state_sharding=state_sharding, batch_spec=batch_spec,
+        grad_norm=config.log_grad_norm,
     )
     eval_step = make_eval_step(
         task, mesh, state_sharding=state_sharding, batch_spec=batch_spec
@@ -658,7 +676,7 @@ def train(config: TrainConfig) -> dict:
             config, dataset, val_dataset, mesh, state, rng, train_step,
             eval_step, logger, timer, worker_pool, ckpt, start_epoch,
             total_start, n_devices, results, global_step, profiling,
-            index_pool,
+            index_pool, lr_schedule_fn(config, total_steps),
         )
     finally:
         if config.profile_dir:
@@ -676,12 +694,15 @@ def train(config: TrainConfig) -> dict:
 def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 eval_step, logger, timer, worker_pool, ckpt, start_epoch,
                 total_start, n_devices, results, global_step, profiling,
-                index_pool=None):
+                index_pool=None, lr_fn=None):
     # HBM-resident dataset cache (--device_cache): filled on the first
     # executed epoch, replayed afterwards. See TrainConfig.device_cache.
     cache: list = []
     cache_ok = config.device_cache
     history: list = []  # per-epoch metrics, returned as results["history"]
+    # Schedule position survives resume inside the restored optimizer state;
+    # the lr telemetry must count from there, not from this run's step 0.
+    base_step = int(state.step)
     for epoch in range(start_epoch, config.epochs):
         replay = cache_ok and epoch > start_epoch and len(cache) > 0
         if replay:
@@ -745,7 +766,11 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                     profiling = False
             rng, step_rng = jax.random.split(rng)
             timer.step_start()
-            state, loss = train_step(state, batch, step_rng)
+            if config.log_grad_norm:
+                state, loss, gnorm = train_step(state, batch, step_rng)
+            else:
+                state, loss = train_step(state, batch, step_rng)
+                gnorm = None
             loss_sum = loss_sum + loss
             # Bound the async dispatch queue (each in-flight step pins its
             # global batch on device) — independent of logging, so neither
@@ -772,20 +797,29 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 # fetch above already materialised this step's scalar.
                 w = timer.window()
                 wt = w["loader_s"] + w["step_s"]
-                logger.log(
-                    {
-                        "step": global_step,
-                        "epoch": epoch,
-                        "loss": round(float(loss), 4),
-                        "images_per_sec": (
-                            config.batch_size * w["steps"] / wt if wt else 0.0
-                        ),
-                        "loader_stall_pct": (
-                            100.0 * w["loader_s"] / wt if wt else 0.0
-                        ),
-                    },
-                    to_wandb=False,
-                )
+                entry = {
+                    "step": global_step,
+                    "epoch": epoch,
+                    "loss": round(float(loss), 4),
+                    "images_per_sec": (
+                        config.batch_size * w["steps"] / wt if wt else 0.0
+                    ),
+                    "loader_stall_pct": (
+                        100.0 * w["loader_s"] / wt if wt else 0.0
+                    ),
+                }
+                if lr_fn is not None:
+                    # Schedules count optimizer updates, not micro-steps;
+                    # base_step carries the restored position across resume.
+                    updates = (base_step + global_step) // max(
+                        config.grad_accum, 1
+                    )
+                    entry["lr"] = float(
+                        lr_fn(updates) if callable(lr_fn) else lr_fn
+                    )
+                if gnorm is not None:
+                    entry["grad_norm"] = round(float(gnorm), 4)
+                logger.log(entry, to_wandb=False)
         if profiling:  # epoch shorter than the trace window
             jax.profiler.stop_trace()
             profiling = False

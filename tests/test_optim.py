@@ -201,6 +201,32 @@ def test_fsdp_matches_dp():
     assert shard.shape == (kernel.shape[0], kernel.shape[1] // 8)
 
 
+def test_per_step_lr_and_grad_norm_logged(image_dataset, capsys):
+    """--log_grad_norm + a cosine schedule: progress lines carry the live lr
+    (decaying) and the pre-clip global gradient norm."""
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    cfg = TrainConfig(
+        dataset_path=image_dataset.uri, num_classes=10, model_name="resnet18",
+        image_size=32, batch_size=16, epochs=1, no_wandb=True, augment=False,
+        eval_at_end=False, log_every=1, log_grad_norm=True,
+        lr_schedule="cosine", lr=0.1,
+    )
+    results = train(cfg)
+    assert np.isfinite(results["loss"])
+    lines = [
+        l for l in capsys.readouterr().out.splitlines()
+        if "[metrics]" in l and "lr=" in l
+    ]
+    assert lines, "no per-step lr lines logged"
+    assert all("grad_norm=" in l for l in lines)
+    lrs = [float(l.split("lr=")[1].split(",")[0]) for l in lines]
+    # First logged step is update 1 of a ~15-update cosine horizon: near
+    # peak but already off it; the tail must have decayed well below.
+    assert 0.08 < lrs[0] <= 0.1
+    assert lrs[-1] < lrs[0] * 0.9
+
+
 def test_train_entrypoint_fsdp_adamw_cosine(tmp_path):
     """End-to-end train(): fsdp + adamw + cosine warmup + grad_accum through
     the real entry point on a synthetic token dataset."""
