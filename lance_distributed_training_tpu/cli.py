@@ -74,6 +74,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--producer_threads", type=int, default=4,
                    help="decode-producer threads (cross-batch decode + "
                         "H2D overlap)")
+    p.add_argument("--data_echo", type=int, default=1,
+                   help=">1: run N train steps per host batch with fresh "
+                        "on-device augmentation each echo (data echoing) — "
+                        "~Nx throughput when the input pipeline is the "
+                        "bottleneck")
     p.add_argument("--device_cache", action="store_true",
                    help="keep epoch-0 batches resident in HBM and replay "
                         "them in later epochs (no host decode / H2D; "
@@ -212,6 +217,7 @@ def main(argv=None) -> dict:
         vocab_size=args.vocab_size,
         prefetch=args.prefetch,
         producer_threads=args.producer_threads,
+        data_echo=args.data_echo,
         device_cache=args.device_cache,
         device_cache_gb=args.device_cache_gb,
         shuffle=args.shuffle,

@@ -108,6 +108,13 @@ class TrainConfig:
     prefetch: int = 2
     producer_threads: int = 4  # decode-producer threads; also pipelines the
     # per-batch H2D copy (expensive on tunneled TPU clients) across threads
+    data_echo: int = 1  # >1: run N train steps per host batch ("data
+    # echoing", Choi et al. 2019) — each echo re-draws the on-device
+    # augmentation / MLM masking rng, so echoes are not exact repeats. When
+    # the host pipeline (decode / H2D) is the bottleneck, throughput scales
+    # ~N× at a modest statistical cost; when the device is the bottleneck it
+    # changes nothing. Composes with device_cache (echo shapes epoch 0; the
+    # cache stores each batch once).
     device_cache: bool = False  # HBM-resident dataset: keep epoch-0 batches
     # on device and replay them in later epochs — no host decode, no H2D.
     # Correct for every task here because augmentation / MLM masking run ON
@@ -617,7 +624,11 @@ def train(config: TrainConfig) -> dict:
             from .data.authoring import _folder_samples
 
             rows = len(_folder_samples(config.dataset_path)[0])
-        total_steps = max(rows // config.batch_size, 1) * config.epochs
+        total_steps = (
+            max(rows // config.batch_size, 1)
+            * config.epochs
+            * max(config.data_echo, 1)  # echoes are real optimizer steps
+        )
     state, state_sharding = create_sharded_train_state(
         init_rng, task, config, mesh, rules,
         fsdp_axis="data" if config.fsdp else None, total_steps=total_steps,
@@ -757,69 +768,79 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 and jax.process_index() == 0
             ):
                 # Trace a post-compile window of the first epoch: steps
-                # [2, 12). Step 0/1 are compile+warmup noise.
-                if epoch_step == 2 and not profiling:
+                # [2, 12). Step 0/1 are compile+warmup noise. Threshold
+                # comparisons, not equality: with data_echo > 1 epoch_step
+                # advances by the echo factor per host batch and can step
+                # OVER any single value.
+                if 2 <= epoch_step < 12 and not profiling:
                     jax.profiler.start_trace(config.profile_dir)
                     profiling = True
-                elif epoch_step == 12 and profiling:
+                elif epoch_step >= 12 and profiling:
                     jax.profiler.stop_trace()
                     profiling = False
-            rng, step_rng = jax.random.split(rng)
-            timer.step_start()
-            if config.log_grad_norm:
-                state, loss, gnorm = train_step(state, batch, step_rng)
-            else:
-                state, loss = train_step(state, batch, step_rng)
-                gnorm = None
-            loss_sum = loss_sum + loss
-            # Bound the async dispatch queue (each in-flight step pins its
-            # global batch on device) — independent of logging, so neither
-            # log_every=0 nor a huge log_every can unbound device memory.
-            # A scalar VALUE fetch, not block_until_ready: on the tunneled
-            # TPU backend block_until_ready returns before execution
-            # completes (verified empirically), so only a D2H fetch
-            # actually drains the queue — and it doubles as honest timing.
-            # Also fetch at log points (log_every may exceed or not divide
-            # sync_every), so the drain lands INSIDE the timed step segment
-            # and the progress window's rate stays honest.
-            sync_every = min(config.log_every or 50, 50)
-            if (global_step + 1) % sync_every == 0 or (
-                config.log_every and (global_step + 1) % config.log_every == 0
-            ):
-                _ = float(loss)  # fetch = drain; value reused at log points
-            timer.step_stop()
-            global_step += 1
-            epoch_step += 1
-            if config.log_every and global_step % config.log_every == 0:
-                # Per-step progress — the reference's live tqdm it/s + loss
-                # (lance_iterable.py:106,116-117). Console/JSONL only; wandb
-                # stays on the per-epoch axis. The loss D2H is cheap: the
-                # fetch above already materialised this step's scalar.
-                w = timer.window()
-                wt = w["loader_s"] + w["step_s"]
-                entry = {
-                    "step": global_step,
-                    "epoch": epoch,
-                    "loss": round(float(loss), 4),
-                    "images_per_sec": (
-                        config.batch_size * w["steps"] / wt if wt else 0.0
-                    ),
-                    "loader_stall_pct": (
-                        100.0 * w["loader_s"] / wt if wt else 0.0
-                    ),
-                }
-                if lr_fn is not None:
-                    # Schedules count optimizer updates, not micro-steps;
-                    # base_step carries the restored position across resume.
-                    updates = (base_step + global_step) // max(
-                        config.grad_accum, 1
-                    )
-                    entry["lr"] = float(
-                        lr_fn(updates) if callable(lr_fn) else lr_fn
-                    )
-                if gnorm is not None:
-                    entry["grad_norm"] = round(float(gnorm), 4)
-                logger.log(entry, to_wandb=False)
+            for _echo in range(max(config.data_echo, 1)):
+                # Data echoing: each echo re-splits the rng, so on-device
+                # augmentation / MLM masking differ between echoes of the
+                # same host batch (TrainConfig.data_echo).
+                rng, step_rng = jax.random.split(rng)
+                timer.step_start()
+                if config.log_grad_norm:
+                    state, loss, gnorm = train_step(state, batch, step_rng)
+                else:
+                    state, loss = train_step(state, batch, step_rng)
+                    gnorm = None
+                loss_sum = loss_sum + loss
+                # Bound the async dispatch queue (each in-flight step pins
+                # its global batch on device) — independent of logging, so
+                # neither log_every=0 nor a huge log_every can unbound
+                # device memory. A scalar VALUE fetch, not
+                # block_until_ready: on the tunneled TPU backend
+                # block_until_ready returns before execution completes
+                # (verified empirically), so only a D2H fetch actually
+                # drains the queue — and it doubles as honest timing. Also
+                # fetch at log points (log_every may exceed or not divide
+                # sync_every), so the drain lands INSIDE the timed step
+                # segment and the progress window's rate stays honest.
+                sync_every = min(config.log_every or 50, 50)
+                if (global_step + 1) % sync_every == 0 or (
+                    config.log_every
+                    and (global_step + 1) % config.log_every == 0
+                ):
+                    _ = float(loss)  # fetch = drain; reused at log points
+                timer.step_stop()
+                global_step += 1
+                epoch_step += 1
+                if config.log_every and global_step % config.log_every == 0:
+                    # Per-step progress — the reference's live tqdm it/s +
+                    # loss (lance_iterable.py:106,116-117). Console/JSONL
+                    # only; wandb stays on the per-epoch axis. The loss D2H
+                    # is cheap: the fetch above already materialised it.
+                    w = timer.window()
+                    wt = w["loader_s"] + w["step_s"]
+                    entry = {
+                        "step": global_step,
+                        "epoch": epoch,
+                        "loss": round(float(loss), 4),
+                        "images_per_sec": (
+                            config.batch_size * w["steps"] / wt if wt else 0.0
+                        ),
+                        "loader_stall_pct": (
+                            100.0 * w["loader_s"] / wt if wt else 0.0
+                        ),
+                    }
+                    if lr_fn is not None:
+                        # Schedules count optimizer updates, not
+                        # micro-steps; base_step carries the restored
+                        # position across resume.
+                        updates = (base_step + global_step) // max(
+                            config.grad_accum, 1
+                        )
+                        entry["lr"] = float(
+                            lr_fn(updates) if callable(lr_fn) else lr_fn
+                        )
+                    if gnorm is not None:
+                        entry["grad_norm"] = round(float(gnorm), 4)
+                    logger.log(entry, to_wandb=False)
         if profiling:  # epoch shorter than the trace window
             jax.profiler.stop_trace()
             profiling = False
@@ -845,6 +866,13 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             ),
             "loader_stall_pct": timer.loader_stall_pct,
         }
+        if config.data_echo > 1:
+            # Rate above counts every echoed step's batch; unique images/sec
+            # is that divided by the echo factor — report both honestly.
+            epoch_metrics["data_echo"] = config.data_echo
+            epoch_metrics["unique_images_per_sec"] = (
+                epoch_metrics["images_per_sec"] / config.data_echo
+            )
         if config.eval_every and (epoch + 1) % config.eval_every == 0:
             # Worker pools are bound to the TRAIN dataset URI; a held-out
             # split must not reuse them.

@@ -59,6 +59,49 @@ def test_device_cache_size_guard_falls_back(image_dataset, monkeypatch):
     assert np.isfinite(results["loss"])
 
 
+def test_data_echo_multiplies_steps(image_dataset, monkeypatch):
+    """--data_echo 3: each host batch is stepped 3 times (fresh rng per
+    echo), so the optimizer sees 3x the steps of the plain plan."""
+    calls = {"n": 0}
+    original = trainer_mod.make_train_step
+
+    def counting_factory(*args, **kw):
+        step = original(*args, **kw)
+
+        def counted(*a, **k):
+            calls["n"] += 1
+            return step(*a, **k)
+
+        return counted
+
+    monkeypatch.setattr(trainer_mod, "make_train_step", counting_factory)
+    results = train(
+        _cfg(image_dataset.uri, epochs=1, device_cache=False, data_echo=3)
+    )
+    assert np.isfinite(results["loss"])
+    # 240 rows, global batch 32 → 7 plan steps (drop-last) × 3 echoes.
+    assert calls["n"] == 21
+
+
+def test_data_echo_scales_schedule_horizon(image_dataset, monkeypatch):
+    """Echoes are real optimizer steps: the derived cosine horizon must be
+    multiplied by the echo factor or the lr hits 0 after 1/N of training."""
+    seen = {}
+    original = trainer_mod.create_sharded_train_state
+
+    def capture(rng, task, config, mesh, rules=(), **kw):
+        seen["total_steps"] = kw.get("total_steps")
+        return original(rng, task, config, mesh, rules, **kw)
+
+    monkeypatch.setattr(trainer_mod, "create_sharded_train_state", capture)
+    train(
+        _cfg(image_dataset.uri, epochs=2, device_cache=False, data_echo=3,
+             lr_schedule="cosine")
+    )
+    # 240 rows, batch 32 → 7 steps/epoch × 2 epochs × 3 echoes.
+    assert seen["total_steps"] == 7 * 2 * 3
+
+
 def test_device_cache_shuffle_permutes_batch_order(image_dataset, monkeypatch):
     """shuffle + cache: replay epochs permute the cached batch order (seeded,
     deterministic) rather than silently replaying identical order."""
