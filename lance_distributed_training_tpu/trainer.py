@@ -714,6 +714,7 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
     # Schedule position survives resume inside the restored optimizer state;
     # the lr telemetry must count from there, not from this run's step 0.
     base_step = int(state.step)
+    trace_done = False  # one profiler window per run
     for epoch in range(start_epoch, config.epochs):
         replay = cache_ok and epoch > start_epoch and len(cache) > 0
         if replay:
@@ -767,17 +768,20 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 and epoch == start_epoch
                 and jax.process_index() == 0
             ):
-                # Trace a post-compile window of the first epoch: steps
-                # [2, 12). Step 0/1 are compile+warmup noise. Threshold
-                # comparisons, not equality: with data_echo > 1 epoch_step
-                # advances by the echo factor per host batch and can step
-                # OVER any single value.
-                if 2 <= epoch_step < 12 and not profiling:
+                # Trace a post-compile window of the first epoch: from the
+                # first host batch at epoch_step >= 2 until epoch_step >= 12
+                # (or epoch end). Step 0/1 are compile+warmup noise.
+                # Threshold comparisons + a one-shot flag, not equality or a
+                # half-open range: with data_echo > 1 epoch_step advances by
+                # the echo factor per host batch and can step over any
+                # single value — or the whole [2, 12) window when echo >= 12.
+                if epoch_step >= 2 and not profiling and not trace_done:
                     jax.profiler.start_trace(config.profile_dir)
                     profiling = True
-                elif epoch_step >= 12 and profiling:
+                elif profiling and epoch_step >= 12:
                     jax.profiler.stop_trace()
                     profiling = False
+                    trace_done = True
             for _echo in range(max(config.data_echo, 1)):
                 # Data echoing: each echo re-splits the rng, so on-device
                 # augmentation / MLM masking differ between echoes of the
@@ -840,6 +844,14 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                         )
                     if gnorm is not None:
                         entry["grad_norm"] = round(float(gnorm), 4)
+                    if config.data_echo > 1:
+                        # The windowed rate counts echoed steps; report the
+                        # unique-data rate next to it (as the epoch metrics
+                        # do) so the live stream is never silently inflated.
+                        entry["data_echo"] = config.data_echo
+                        entry["unique_images_per_sec"] = (
+                            entry["images_per_sec"] / config.data_echo
+                        )
                     logger.log(entry, to_wandb=False)
         if profiling:  # epoch shorter than the trace window
             jax.profiler.stop_trace()

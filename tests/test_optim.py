@@ -201,6 +201,50 @@ def test_fsdp_matches_dp():
     assert shard.shape == (kernel.shape[0], kernel.shape[1] // 8)
 
 
+def test_fsdp_composes_with_tp():
+    """Hybrid 2D sharding on a dp=4×tp=2 mesh: TP rules own the matched
+    leaves, FSDP shards the rest over 'data' — and one train step still
+    matches the fully-replicated dp=8 result. seq_len 128 makes pos_embed
+    (128×256 = 32 Ki elements) big enough for the FSDP cutoff."""
+    seq = 128
+
+    def one_step(mesh, rules, fsdp_axis):
+        task = get_task("masked_lm", model_name="bert_small", seq_len=seq,
+                        vocab_size=VOCAB)
+        cfg = _cfg(lr=0.1, momentum=0.9)
+        state, sharding = create_sharded_train_state(
+            jax.random.key(0), task, cfg, mesh, rules, fsdp_axis=fsdp_axis
+        )
+        step = make_train_step(task, mesh, state_sharding=sharding,
+                               donate=False)
+        gen = np.random.default_rng(0)
+        batch = make_global_batch(
+            {
+                "input_ids": gen.integers(2, VOCAB, (16, seq)).astype(
+                    np.int32
+                ),
+                "attention_mask": np.ones((16, seq), np.int8),
+            },
+            mesh,
+        )
+        new_state, loss = step(state, batch, jax.random.key(1))
+        probe = np.asarray(
+            jax.device_get(new_state.params["layer_0"]["mlp_in"]["kernel"])
+        )
+        return new_state, probe, float(loss)
+
+    _, probe_dp, loss_dp = one_step(get_mesh(), (), None)
+    mesh2 = get_mesh(model_parallelism=2)
+    state2, probe2, loss2 = one_step(mesh2, TRANSFORMER_RULES, "data")
+    # TP rule holds on matched leaves; unmatched big leaves shard over data.
+    assert state2.params["layer_0"]["mlp_in"]["kernel"].sharding.spec == P(
+        None, "model"
+    )
+    assert state2.params["pos_embed"].sharding.spec == P(None, "data")
+    np.testing.assert_allclose(loss2, loss_dp, rtol=2e-2)
+    np.testing.assert_allclose(probe2, probe_dp, rtol=3e-2, atol=3e-3)
+
+
 def test_per_step_lr_and_grad_norm_logged(image_dataset, capsys):
     """--log_grad_norm + a cosine schedule: progress lines carry the live lr
     (decaying) and the pre-clip global gradient norm."""
