@@ -23,6 +23,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dataset_path", type=str, required=True)
     p.add_argument("--val_dataset_path", type=str, default=None,
                    help="held-out split for evaluation (default: train loader)")
+    p.add_argument("--val_fraction", type=float, default=0.0,
+                   help=">0: carve a seeded held-out fraction of the train "
+                        "dataset as the val split (map-style columnar path; "
+                        "composes with --filter)")
     p.add_argument("--task_type", type=str, default="classification",
                    choices=["classification", "masked_lm", "causal_lm",
                             "contrastive"])
@@ -190,6 +194,7 @@ def main(argv=None) -> dict:
     config = TrainConfig(
         dataset_path=args.dataset_path,
         val_dataset_path=args.val_dataset_path,
+        val_fraction=args.val_fraction,
         task_type=args.task_type,
         num_classes=args.num_classes,
         sampler_type=args.sampler_type,

@@ -77,6 +77,12 @@ class TrainConfig:
     val_dataset_path: Optional[str] = None  # held-out split for eval_every /
     # eval_at_end (the reference's Food101 split='test' val loader,
     # torch_version/map_style.py:57); default: eval over the train loader
+    val_fraction: float = 0.0  # >0: carve a seeded held-out fraction of the
+    # train dataset as the val split (torch random_split equivalent;
+    # torch_version/map_style.py:57's train/val separation without a second
+    # dataset). Map-style columnar path; composes with --filter (the split
+    # happens inside the filtered pool). Mutually exclusive with
+    # val_dataset_path.
     task_type: str = "classification"
     num_classes: int = 101
     sampler_type: str = "batch"  # batch | fragment | full (lance_iterable.py:61-69)
@@ -568,6 +574,20 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
 
 def train(config: TrainConfig) -> dict:
     """The single training entry point. Returns final metrics."""
+    if config.val_fraction > 0:
+        # Validate the combo BEFORE any dataset I/O so a bad config fails
+        # with its own message, not a dataset-open error.
+        if config.val_dataset_path:
+            raise ValueError(
+                "val_fraction and val_dataset_path are mutually exclusive"
+            )
+        if config.data_format != "columnar" or config.loader_style != "map":
+            raise ValueError(
+                "val_fraction needs the map-style columnar path (the split "
+                "is an index pool); pass loader_style='map'"
+            )
+        if not config.val_fraction < 1.0:
+            raise ValueError("val_fraction must be in (0, 1)")
     maybe_initialize_distributed(
         config.coordinator_address, config.num_processes, config.process_id
     )
@@ -610,6 +630,28 @@ def train(config: TrainConfig) -> dict:
         and config.loader_style == "map"
     ):
         index_pool = dataset.filter_indices(config.filter)
+    # Held-out validation fraction: a seeded disjoint split of the (possibly
+    # filtered) row pool. Deterministic across processes — every process
+    # derives the same split, preserving the equal-step invariant.
+    val_pool = None
+    if config.val_fraction > 0:
+        import numpy as np
+
+        pool = (
+            index_pool
+            if index_pool is not None
+            else np.arange(dataset.count_rows(), dtype=np.int64)
+        )
+        n_val = max(int(len(pool) * config.val_fraction), config.batch_size)
+        if len(pool) - n_val < config.batch_size:
+            raise ValueError(
+                f"val_fraction {config.val_fraction} leaves fewer than one "
+                f"global batch ({config.batch_size}) on one side of the "
+                f"split ({len(pool)} rows available)"
+            )
+        perm = np.random.default_rng(config.seed).permutation(len(pool))
+        val_pool = np.sort(pool[perm[:n_val]])
+        index_pool = np.sort(pool[perm[n_val:]])
     total_steps = config.total_steps
     if total_steps is None and config.lr_schedule != "constant":
         # Schedule horizon: steps/epoch × epochs. rows // batch matches the
@@ -687,7 +729,7 @@ def train(config: TrainConfig) -> dict:
             config, dataset, val_dataset, mesh, state, rng, train_step,
             eval_step, logger, timer, worker_pool, ckpt, start_epoch,
             total_start, n_devices, results, global_step, profiling,
-            index_pool, lr_schedule_fn(config, total_steps),
+            index_pool, lr_schedule_fn(config, total_steps), val_pool,
         )
     finally:
         if config.profile_dir:
@@ -705,7 +747,7 @@ def train(config: TrainConfig) -> dict:
 def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 eval_step, logger, timer, worker_pool, ckpt, start_epoch,
                 total_start, n_devices, results, global_step, profiling,
-                index_pool=None, lr_fn=None):
+                index_pool=None, lr_fn=None, val_pool=None):
     # HBM-resident dataset cache (--device_cache): filled on the first
     # executed epoch, replayed afterwards. See TrainConfig.device_cache.
     cache: list = []
@@ -715,6 +757,19 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
     # the lr telemetry must count from there, not from this run's step 0.
     base_step = int(state.step)
     trace_done = False  # one profiler window per run
+    # Eval-loader selection, shared by eval_every and eval_at_end. Worker
+    # pools are bound to the TRAIN dataset URI; a held-out val DATASET must
+    # not reuse them, while a val_fraction split (same dataset) can.
+    # Pool precedence: val_fraction split → train pool (eval over the train
+    # loader) → a val dataset resolves its OWN filter pool via the fallback
+    # in _build_loader.
+    eval_dataset = val_dataset if val_dataset is not None else dataset
+    eval_workers = worker_pool if val_dataset is None else None
+    eval_pool = (
+        val_pool if val_pool is not None
+        else index_pool if val_dataset is None
+        else None
+    )
     for epoch in range(start_epoch, config.epochs):
         replay = cache_ok and epoch > start_epoch and len(cache) > 0
         if replay:
@@ -886,17 +941,9 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 epoch_metrics["images_per_sec"] / config.data_echo
             )
         if config.eval_every and (epoch + 1) % config.eval_every == 0:
-            # Worker pools are bound to the TRAIN dataset URI; a held-out
-            # split must not reuse them.
             val_loader = _build_loader(
-                config,
-                val_dataset if val_dataset is not None else dataset,
-                mesh,
-                epoch,
-                worker_pool if val_dataset is None else None,
-                # A held-out val dataset resolves its OWN pool (fallback in
-                # _build_loader); eval over the train loader reuses the pool.
-                index_pool=index_pool if val_dataset is None else None,
+                config, eval_dataset, mesh, epoch, eval_workers,
+                index_pool=eval_pool,
             )
             epoch_metrics["val_acc"] = evaluate(state, val_loader, eval_step)
         logger.log(epoch_metrics, step=epoch)
@@ -912,14 +959,13 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
         # Final eval — over the val split when given, else over the train
         # loader as the reference does (lance_iterable.py:125-127); all
         # processes participate since eval is itself a sharded computation.
-        key = "val_acc" if val_dataset is not None else "train_acc"
+        key = (
+            "val_acc"
+            if (val_dataset is not None or val_pool is not None)
+            else "train_acc"
+        )
         loader = _build_loader(
-            config,
-            val_dataset if val_dataset is not None else dataset,
-            mesh,
-            0,
-            worker_pool if val_dataset is None else None,
-            index_pool=index_pool if val_dataset is None else None,
+            config, eval_dataset, mesh, 0, eval_workers, index_pool=eval_pool
         )
         results[key] = evaluate(state, loader, eval_step)
         logger.log({key: results[key]})

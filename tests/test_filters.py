@@ -153,6 +153,70 @@ def test_filter_shrinks_cosine_horizon(image_dataset):
     assert seen["total_steps"] == max(pool // 16, 1) * 2
 
 
+def test_val_fraction_split(image_dataset):
+    """--val_fraction: seeded held-out split from the train dataset —
+    training uses the rest, eval_at_end reports val_acc over the split."""
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    cfg = TrainConfig(
+        dataset_path=image_dataset.uri, num_classes=10, model_name="resnet18",
+        image_size=32, batch_size=16, epochs=1, no_wandb=True, augment=False,
+        eval_at_end=True, loader_style="map", val_fraction=0.2,
+    )
+    results = train(cfg)
+    assert np.isfinite(results["loss"])
+    assert "val_acc" in results and 0.0 <= results["val_acc"] <= 1.0
+
+
+def test_val_fraction_composes_with_filter(image_dataset, monkeypatch):
+    """The split happens INSIDE the filtered pool: train and val pools are
+    disjoint and both satisfy the predicate."""
+    import lance_distributed_training_tpu.trainer as trainer_mod
+    from lance_distributed_training_tpu.data.pipeline import MapStylePipeline
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    pools = []
+    original_init = MapStylePipeline.__init__
+
+    def recording_init(self, *args, **kw):
+        original_init(self, *args, **kw)
+        if self.index_pool is not None:
+            pools.append(np.asarray(self.index_pool))
+
+    monkeypatch.setattr(MapStylePipeline, "__init__", recording_init)
+    cfg = TrainConfig(
+        dataset_path=image_dataset.uri, num_classes=10, model_name="resnet18",
+        image_size=32, batch_size=16, epochs=1, no_wandb=True, augment=False,
+        eval_at_end=True, loader_style="map", filter="label < 5",
+        val_fraction=0.25,
+    )
+    train(cfg)
+    assert len(pools) >= 2
+    train_pool, val_pool = pools[0], pools[-1]
+    assert not set(train_pool) & set(val_pool)
+    ds = trainer_mod.Dataset(image_dataset.uri)
+    for p in (train_pool, val_pool):
+        labels = ds.take(p).column("label").to_numpy()
+        assert (labels < 5).all()
+
+
+def test_val_fraction_validation_errors(image_dataset):
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    base = dict(
+        dataset_path=image_dataset.uri, num_classes=10, model_name="resnet18",
+        image_size=32, batch_size=16, epochs=1, no_wandb=True,
+        eval_at_end=False,
+    )
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        train(TrainConfig(**base, loader_style="map", val_fraction=0.2,
+                          val_dataset_path="/x"))
+    with pytest.raises(ValueError, match="map-style"):
+        train(TrainConfig(**base, val_fraction=0.2))
+    with pytest.raises(ValueError, match="fewer than one global batch"):
+        train(TrainConfig(**base, loader_style="map", val_fraction=0.95))
+
+
 def test_filter_requires_map_style(image_dataset):
     from lance_distributed_training_tpu.trainer import TrainConfig, train
 

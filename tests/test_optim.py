@@ -271,6 +271,35 @@ def test_per_step_lr_and_grad_norm_logged(image_dataset, capsys):
     assert lrs[-1] < lrs[0] * 0.9
 
 
+def test_lr_telemetry_resumes_mid_schedule(image_dataset, tmp_path, capsys):
+    """After a checkpoint resume the logged lr must continue from the
+    restored schedule position (the optimizer state's count), not restart
+    at the warmup/peak."""
+    import dataclasses
+
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    cfg = TrainConfig(
+        dataset_path=image_dataset.uri, num_classes=10, model_name="resnet18",
+        image_size=32, batch_size=32, epochs=2, no_wandb=True, augment=False,
+        eval_at_end=False, log_every=1, lr=0.1, lr_schedule="cosine",
+        checkpoint_dir=str(tmp_path / "ck"),
+    )
+    train(cfg)  # 7 steps/epoch × 2 epochs; checkpoint at epoch 2
+    capsys.readouterr()
+    # Resume into a longer run: horizon 7×4 = 28 updates, restored count 14.
+    train(dataclasses.replace(cfg, epochs=4))
+    lines = [
+        l for l in capsys.readouterr().out.splitlines()
+        if "[metrics]" in l and "lr=" in l
+    ]
+    assert lines
+    first_lr = float(lines[0].split("lr=")[1].split(",")[0])
+    # cosine(15/28) ≈ 0.046 — far below peak; a schedule restarted from the
+    # top would log ≈ 0.0997 here.
+    assert first_lr < 0.08
+
+
 def test_train_entrypoint_fsdp_adamw_cosine(tmp_path):
     """End-to-end train(): fsdp + adamw + cosine warmup + grad_accum through
     the real entry point on a synthetic token dataset."""
