@@ -574,9 +574,13 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
 
 def train(config: TrainConfig) -> dict:
     """The single training entry point. Returns final metrics."""
-    if config.val_fraction > 0:
+    if config.val_fraction:
         # Validate the combo BEFORE any dataset I/O so a bad config fails
         # with its own message, not a dataset-open error.
+        if not 0.0 < config.val_fraction < 1.0:
+            raise ValueError(
+                f"val_fraction must be in (0, 1), got {config.val_fraction}"
+            )
         if config.val_dataset_path:
             raise ValueError(
                 "val_fraction and val_dataset_path are mutually exclusive"
@@ -586,8 +590,6 @@ def train(config: TrainConfig) -> dict:
                 "val_fraction needs the map-style columnar path (the split "
                 "is an index pool); pass loader_style='map'"
             )
-        if not config.val_fraction < 1.0:
-            raise ValueError("val_fraction must be in (0, 1)")
     maybe_initialize_distributed(
         config.coordinator_address, config.num_processes, config.process_id
     )
@@ -642,7 +644,18 @@ def train(config: TrainConfig) -> dict:
             if index_pool is not None
             else np.arange(dataset.count_rows(), dtype=np.int64)
         )
-        n_val = max(int(len(pool) * config.val_fraction), config.batch_size)
+        n_val = int(len(pool) * config.val_fraction)
+        if n_val < config.batch_size:
+            # Eval needs at least one full global batch; never silently.
+            import warnings
+
+            warnings.warn(
+                f"val_fraction {config.val_fraction} yields {n_val} rows — "
+                f"raised to one global batch ({config.batch_size} rows = "
+                f"{config.batch_size / len(pool):.1%} of the pool)",
+                stacklevel=2,
+            )
+            n_val = config.batch_size
         if len(pool) - n_val < config.batch_size:
             raise ValueError(
                 f"val_fraction {config.val_fraction} leaves fewer than one "

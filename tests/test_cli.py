@@ -82,3 +82,20 @@ def test_cli_optimizer_and_cache_flags(monkeypatch):
     assert config.fsdp is True
     assert config.device_cache is True
     assert config.device_cache_gb == 2.5
+
+
+def test_cli_data_and_eval_flags(monkeypatch):
+    captured = {}
+    monkeypatch.setattr(
+        cli, "train", lambda config: captured.update(config=config) or {}
+    )
+    cli.main([
+        "--dataset_path", "/d", "--no_wandb", "--loader_style", "map",
+        "--filter", "label < 5", "--val_fraction", "0.1",
+        "--data_echo", "4", "--log_grad_norm",
+    ])
+    config = captured["config"]
+    assert config.filter == "label < 5"
+    assert config.val_fraction == 0.1
+    assert config.data_echo == 4
+    assert config.log_grad_norm is True
