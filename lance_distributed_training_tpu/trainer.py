@@ -644,6 +644,13 @@ def train(config: TrainConfig) -> dict:
             if index_pool is not None
             else np.arange(dataset.count_rows(), dtype=np.int64)
         )
+        if len(pool) < 2 * config.batch_size:
+            # Both sides need at least one full global batch (also guards
+            # an empty --filter pool before any division below).
+            raise ValueError(
+                f"val_fraction needs at least two global batches "
+                f"(2×{config.batch_size}) in the pool; have {len(pool)} rows"
+            )
         n_val = int(len(pool) * config.val_fraction)
         if n_val < config.batch_size:
             # Eval needs at least one full global batch; never silently.
