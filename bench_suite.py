@@ -1,4 +1,4 @@
-"""Benchmark suite — one entry per BASELINE.json config.
+"""Benchmark suite — one entry per BASELINE.json config, plus one extra.
 
 The driver's headline metric stays in ``bench.py`` (FOOD101 ResNet-50
 iterable, images/sec/chip). This suite covers all five BASELINE configs end
@@ -14,10 +14,13 @@ loop, so the numbers include everything a user would hit:
                               sharded scan (ShardedFragmentSampler parity)
 4. ``c4-bert``                packed token columns → masked-LM BERT
 5. ``laion-clip``             mixed-modal image+caption → CLIP contrastive
+6. ``gpt-causal``             beyond-baseline: the same packed token columns
+                              → decoder-only next-token GPT (causal
+                              attention + shifted loss)
 
 Usage::
 
-    python bench_suite.py                # all five, one JSON line each
+    python bench_suite.py                # all six, one JSON line each
     python bench_suite.py c4-bert        # just one
     BENCH_SMALL=1 python bench_suite.py  # tiny shapes (CI / smoke)
 
@@ -44,6 +47,8 @@ CONFIG_NAMES = [
     "imagenet-fragment",
     "c4-bert",
     "laion-clip",
+    # Beyond the five BASELINE configs: the decoder-only text arm.
+    "gpt-causal",
 ]
 
 
@@ -55,6 +60,11 @@ def _force_cpu(n_devices: int = 1) -> None:
     except RuntimeError:
         pass
     jax.config.update("jax_platforms", "cpu")
+    # init_devices honors an explicit JAX_PLATFORMS env choice by re-pinning
+    # jax_platforms from it — on a box that exports JAX_PLATFORMS=axon that
+    # would silently undo this CPU pin and send a "CPU by definition" config
+    # to the TPU tunnel. Make the env agree with the pin.
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 
 def _train_metrics(cfg, steps_hint: int) -> dict:
@@ -165,18 +175,26 @@ def run_config(name: str) -> dict:
             else None
         )
 
-    elif name == "c4-bert":
-        # Packed token columns → masked-LM BERT (C4 config). bert_base on an
-        # accelerator; bert_small on CPU so the suite stays runnable.
+    elif name in ("c4-bert", "gpt-causal"):
+        # Packed token columns → masked-LM BERT (the C4 BASELINE config) or
+        # decoder-only next-token GPT (beyond-baseline text arm; same
+        # storage/sampler/loader path, causal attention + shifted loss).
+        # Full-size model on an accelerator; small on CPU so the suite
+        # stays runnable.
         import numpy as np
 
         from lance_distributed_training_tpu.data import (
             create_text_token_dataset,
         )
 
+        causal = name == "gpt-causal"
         accel = devices[0].platform != "cpu"
-        model = "bert_base" if accel else "bert_small"
-        vocab = 30522 if accel else 2048
+        if causal:
+            model = "gpt_base" if accel else "gpt_small"
+            vocab = 50257 if accel else 2048
+        else:
+            model = "bert_base" if accel else "bert_small"
+            vocab = 30522 if accel else 2048
         seq_len = 32 if SMALL else 128
         per_chip = 8 if SMALL else (64 if accel else 16)
         batch = per_chip * len(devices)
@@ -191,7 +209,9 @@ def run_config(name: str) -> dict:
         create_text_token_dataset(uri, docs, seq_len=seq_len,
                                   fragment_size=max(rows // 4, 1))
         cfg = TrainConfig(
-            dataset_path=uri, task_type="masked_lm", model_name=model,
+            dataset_path=uri,
+            task_type="causal_lm" if causal else "masked_lm",
+            model_name=model,
             vocab_size=vocab, seq_len=seq_len, batch_size=batch, **common,
         )
         m = _train_metrics(cfg, steps)
