@@ -46,6 +46,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch_size", type=int, default=512,
                    help="GLOBAL batch size across all devices")
     p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--max_steps", type=int, default=0,
+                   help=">0: stop after N train steps regardless of epochs "
+                        "(compile check / smoke / fixed-step bench; counted "
+                        "in data steps like --total_steps)")
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--optimizer", type=str, default="sgd",
@@ -203,6 +207,7 @@ def main(argv=None) -> dict:
         data_format=args.data_format,
         batch_size=args.batch_size,
         epochs=args.epochs,
+        max_steps=args.max_steps,
         lr=args.lr,
         momentum=args.momentum,
         optimizer=args.optimizer,

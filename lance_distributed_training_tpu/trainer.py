@@ -92,6 +92,10 @@ class TrainConfig:
     data_format: str = "columnar"  # columnar | folder (the torch_version/ control arm)
     batch_size: int = 512  # GLOBAL batch (reference default, lance_iterable.py:141)
     epochs: int = 10
+    max_steps: int = 0  # >0: stop after N train (micro) steps regardless of
+    # epochs — compile checks, smoke runs, fixed-step benchmarking. Counted
+    # like total_steps/warmup_steps in data steps: under grad_accum an
+    # optimizer update lands every grad_accum of these.
     lr: float = 0.05
     momentum: float = 0.9
     # -- optimizer/schedule knobs beyond the reference's fixed-lr SGD
@@ -790,6 +794,7 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
         else index_pool if val_dataset is None
         else None
     )
+    stop = False  # set by max_steps; ends the epoch loop after bookkeeping
     for epoch in range(start_epoch, config.epochs):
         replay = cache_ok and epoch > start_epoch and len(cache) > 0
         if replay:
@@ -889,6 +894,8 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 timer.step_stop()
                 global_step += 1
                 epoch_step += 1
+                if 0 < config.max_steps <= global_step:
+                    stop = True
                 if config.log_every and global_step % config.log_every == 0:
                     # Per-step progress — the reference's live tqdm it/s +
                     # loss (lance_iterable.py:106,116-117). Console/JSONL
@@ -928,6 +935,14 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                             entry["images_per_sec"] / config.data_echo
                         )
                     logger.log(entry, to_wandb=False)
+                if stop:
+                    break
+            if stop:
+                # max_steps reached mid-epoch: close the loader's generator
+                # so producer threads observe the stop flag and drain.
+                if hasattr(it, "close"):
+                    it.close()
+                break
         if profiling:  # epoch shorter than the trace window
             jax.profiler.stop_trace()
             profiling = False
@@ -969,8 +984,16 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
         logger.log(epoch_metrics, step=epoch)
         history.append(dict(epoch_metrics))
         results = epoch_metrics
-        if ckpt is not None and (epoch + 1) % config.checkpoint_every == 0:
+        if (
+            ckpt is not None
+            and (epoch + 1) % config.checkpoint_every == 0
+            and not stop
+        ):
+            # A max_steps stop mid-epoch must not checkpoint the partial
+            # epoch as completed — resume would silently skip its remainder.
             ckpt.save(epoch + 1, state)
+        if stop:
+            break
 
     results["history"] = history
     results["total_time"] = time.perf_counter() - total_start

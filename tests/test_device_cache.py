@@ -83,6 +83,30 @@ def test_data_echo_multiplies_steps(image_dataset, monkeypatch):
     assert calls["n"] == 21
 
 
+def test_max_steps_stops_early(image_dataset, monkeypatch):
+    """--max_steps caps optimizer steps mid-epoch, across epochs and echoes;
+    the run still returns epoch metrics and shuts down cleanly."""
+    calls = {"n": 0}
+    original = trainer_mod.make_train_step
+
+    def counting_factory(*args, **kw):
+        step = original(*args, **kw)
+
+        def counted(*a, **k):
+            calls["n"] += 1
+            return step(*a, **k)
+
+        return counted
+
+    monkeypatch.setattr(trainer_mod, "make_train_step", counting_factory)
+    results = train(
+        _cfg(image_dataset.uri, epochs=5, device_cache=False, max_steps=3)
+    )
+    assert calls["n"] == 3
+    assert np.isfinite(results["loss"])
+    assert results["epoch"] == 0  # stopped inside the first epoch
+
+
 def test_data_echo_scales_schedule_horizon(image_dataset, monkeypatch):
     """Echoes are real optimizer steps: the derived cosine horizon must be
     multiplied by the echo factor or the lr hits 0 after 1/N of training."""
