@@ -31,6 +31,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax.training import train_state
 
@@ -576,6 +577,45 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
     return loader
 
 
+def _split_val_pool(config: TrainConfig, dataset, index_pool):
+    """Held-out validation fraction: a seeded disjoint split of the
+    (possibly filtered) row pool. Deterministic across processes — every
+    process derives the same split, preserving the equal-step invariant.
+    Returns ``(train_pool, val_pool)``, both sorted global row indices."""
+    pool = (
+        index_pool
+        if index_pool is not None
+        else np.arange(dataset.count_rows(), dtype=np.int64)
+    )
+    if len(pool) < 2 * config.batch_size:
+        # Both sides need at least one full global batch (also guards an
+        # empty --filter pool before any division below).
+        raise ValueError(
+            f"val_fraction needs at least two global batches "
+            f"(2×{config.batch_size}) in the pool; have {len(pool)} rows"
+        )
+    n_val = int(len(pool) * config.val_fraction)
+    if n_val < config.batch_size:
+        # Eval needs at least one full global batch; never silently.
+        import warnings
+
+        warnings.warn(
+            f"val_fraction {config.val_fraction} yields {n_val} rows — "
+            f"raised to one global batch ({config.batch_size} rows = "
+            f"{config.batch_size / len(pool):.1%} of the pool)",
+            stacklevel=3,
+        )
+        n_val = config.batch_size
+    if len(pool) - n_val < config.batch_size:
+        raise ValueError(
+            f"val_fraction {config.val_fraction} leaves fewer than one "
+            f"global batch ({config.batch_size}) on one side of the "
+            f"split ({len(pool)} rows available)"
+        )
+    perm = np.random.default_rng(config.seed).permutation(len(pool))
+    return np.sort(pool[perm[n_val:]]), np.sort(pool[perm[:n_val]])
+
+
 def train(config: TrainConfig) -> dict:
     """The single training entry point. Returns final metrics."""
     if config.val_fraction:
@@ -636,46 +676,9 @@ def train(config: TrainConfig) -> dict:
         and config.loader_style == "map"
     ):
         index_pool = dataset.filter_indices(config.filter)
-    # Held-out validation fraction: a seeded disjoint split of the (possibly
-    # filtered) row pool. Deterministic across processes — every process
-    # derives the same split, preserving the equal-step invariant.
     val_pool = None
     if config.val_fraction > 0:
-        import numpy as np
-
-        pool = (
-            index_pool
-            if index_pool is not None
-            else np.arange(dataset.count_rows(), dtype=np.int64)
-        )
-        if len(pool) < 2 * config.batch_size:
-            # Both sides need at least one full global batch (also guards
-            # an empty --filter pool before any division below).
-            raise ValueError(
-                f"val_fraction needs at least two global batches "
-                f"(2×{config.batch_size}) in the pool; have {len(pool)} rows"
-            )
-        n_val = int(len(pool) * config.val_fraction)
-        if n_val < config.batch_size:
-            # Eval needs at least one full global batch; never silently.
-            import warnings
-
-            warnings.warn(
-                f"val_fraction {config.val_fraction} yields {n_val} rows — "
-                f"raised to one global batch ({config.batch_size} rows = "
-                f"{config.batch_size / len(pool):.1%} of the pool)",
-                stacklevel=2,
-            )
-            n_val = config.batch_size
-        if len(pool) - n_val < config.batch_size:
-            raise ValueError(
-                f"val_fraction {config.val_fraction} leaves fewer than one "
-                f"global batch ({config.batch_size}) on one side of the "
-                f"split ({len(pool)} rows available)"
-            )
-        perm = np.random.default_rng(config.seed).permutation(len(pool))
-        val_pool = np.sort(pool[perm[:n_val]])
-        index_pool = np.sort(pool[perm[n_val:]])
+        index_pool, val_pool = _split_val_pool(config, dataset, index_pool)
     total_steps = config.total_steps
     if total_steps is None and config.lr_schedule != "constant":
         # Schedule horizon: steps/epoch × epochs. rows // batch matches the
@@ -799,9 +802,7 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
         replay = cache_ok and epoch > start_epoch and len(cache) > 0
         if replay:
             if config.shuffle or config.loader_style == "map":
-                import numpy as _np
-
-                order = _np.random.default_rng(
+                order = np.random.default_rng(
                     config.seed + epoch
                 ).permutation(len(cache))
                 it = iter([cache[i] for i in order])

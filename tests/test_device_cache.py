@@ -38,6 +38,24 @@ def _count_builds(monkeypatch):
     return calls
 
 
+def _count_train_steps(monkeypatch):
+    """Patch make_train_step so every jitted-step invocation is counted."""
+    calls = {"n": 0}
+    original = trainer_mod.make_train_step
+
+    def counting_factory(*args, **kw):
+        step = original(*args, **kw)
+
+        def counted(*a, **k):
+            calls["n"] += 1
+            return step(*a, **k)
+
+        return counted
+
+    monkeypatch.setattr(trainer_mod, "make_train_step", counting_factory)
+    return calls
+
+
 def test_device_cache_builds_one_loader(image_dataset, monkeypatch):
     """3 epochs with the cache: the host pipeline is built exactly once;
     epochs 1-2 replay resident batches and still train (finite loss)."""
@@ -62,19 +80,7 @@ def test_device_cache_size_guard_falls_back(image_dataset, monkeypatch):
 def test_data_echo_multiplies_steps(image_dataset, monkeypatch):
     """--data_echo 3: each host batch is stepped 3 times (fresh rng per
     echo), so the optimizer sees 3x the steps of the plain plan."""
-    calls = {"n": 0}
-    original = trainer_mod.make_train_step
-
-    def counting_factory(*args, **kw):
-        step = original(*args, **kw)
-
-        def counted(*a, **k):
-            calls["n"] += 1
-            return step(*a, **k)
-
-        return counted
-
-    monkeypatch.setattr(trainer_mod, "make_train_step", counting_factory)
+    calls = _count_train_steps(monkeypatch)
     results = train(
         _cfg(image_dataset.uri, epochs=1, device_cache=False, data_echo=3)
     )
@@ -84,21 +90,9 @@ def test_data_echo_multiplies_steps(image_dataset, monkeypatch):
 
 
 def test_max_steps_stops_early(image_dataset, monkeypatch):
-    """--max_steps caps optimizer steps mid-epoch, across epochs and echoes;
+    """--max_steps caps train steps mid-epoch, across epochs and echoes;
     the run still returns epoch metrics and shuts down cleanly."""
-    calls = {"n": 0}
-    original = trainer_mod.make_train_step
-
-    def counting_factory(*args, **kw):
-        step = original(*args, **kw)
-
-        def counted(*a, **k):
-            calls["n"] += 1
-            return step(*a, **k)
-
-        return counted
-
-    monkeypatch.setattr(trainer_mod, "make_train_step", counting_factory)
+    calls = _count_train_steps(monkeypatch)
     results = train(
         _cfg(image_dataset.uri, epochs=5, device_cache=False, max_steps=3)
     )
