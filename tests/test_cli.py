@@ -99,3 +99,11 @@ def test_cli_data_and_eval_flags(monkeypatch):
     assert config.val_fraction == 0.1
     assert config.data_echo == 4
     assert config.log_grad_norm is True
+
+
+def test_top_level_api_exports():
+    """`from lance_distributed_training_tpu import train, TrainConfig`."""
+    import lance_distributed_training_tpu as ldt
+
+    assert callable(ldt.train)
+    assert ldt.TrainConfig(dataset_path="/d").batch_size == 512
