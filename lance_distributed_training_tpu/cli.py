@@ -154,6 +154,16 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def console_entry() -> int:
+    """Entry point for the ``ldt-train`` console script. ``main`` returns
+    the final metrics dict for programmatic callers; a setuptools script
+    wraps its return in ``sys.exit(...)``, which would turn every
+    successful run into exit status 1 with the dict dumped to stderr —
+    so the script target is this wrapper, which discards the dict."""
+    main()
+    return 0
+
+
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
     if args.backend == "cpu":
