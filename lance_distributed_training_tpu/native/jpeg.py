@@ -22,27 +22,60 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "ldt_decode.cpp")
 _LIB_PATH = os.path.join(_HERE, "_ldt_decode.so")
 _ABI_VERSION = 2
+# Fallback build target when the package directory is read-only (system
+# pip installs): a per-user cache, keyed by ABI so upgrades never collide.
+_CACHE_LIB = os.path.join(
+    os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    ),
+    "ldt-native",
+    f"_ldt_decode_abi{_ABI_VERSION}.so",
+)
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 
 
-def _build() -> bool:
-    # Link into a temp file, then rename over _LIB_PATH: the replaced path
+def _build(target: str) -> bool:
+    # Link into a temp file, then rename over the target: the replaced path
     # gets a NEW inode, so a later dlopen cannot be deduplicated against a
     # stale handle that was opened from the old file.
-    tmp = _LIB_PATH + ".tmp"
+    tmp = target + ".tmp"
     cmd = [
         "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
         _SRC, "-o", tmp, "-ljpeg", "-pthread",
     ]
     try:
+        os.makedirs(os.path.dirname(target), exist_ok=True)
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _LIB_PATH)
+        os.replace(tmp, target)
         return True
     except (subprocess.SubprocessError, OSError):
         return False
+
+
+def _load_or_build(path: str) -> Optional[ctypes.CDLL]:
+    """Load ``path`` (building/rebuilding from ``_SRC`` as needed); None on
+    any failure — the caller then tries the next candidate location."""
+    needs_build = not os.path.exists(path) or (
+        os.path.getmtime(path) < os.path.getmtime(_SRC)
+    )
+    if needs_build and not _build(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        if lib.ldt_decode_abi_version() != _ABI_VERSION:
+            if not _build(path):
+                return None
+            lib = ctypes.CDLL(path)
+            if lib.ldt_decode_abi_version() != _ABI_VERSION:
+                # Rebuilt from source yet still mismatched: the source
+                # itself is a different ABI generation — don't bind.
+                return None
+    except OSError:
+        return None
+    return lib
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -53,47 +86,38 @@ def _load() -> Optional[ctypes.CDLL]:
         if os.environ.get("LDT_DISABLE_NATIVE"):
             _load_failed = True
             return None
-        needs_build = not os.path.exists(_LIB_PATH) or (
-            os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
-        )
-        if needs_build and not _build():
+        # Prefer the package dir (repo checkouts, rootful installs); fall
+        # back to the per-user cache when it is not writable — a system pip
+        # install must not silently lose the native decoder.
+        lib = None
+        for path in (_LIB_PATH, _CACHE_LIB):
+            lib = _load_or_build(path)
+            if lib is not None:
+                break
+        if lib is None:
             _load_failed = True
             return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-            if lib.ldt_decode_abi_version() != _ABI_VERSION:
-                if not _build():
-                    _load_failed = True
-                    return None
-                lib = ctypes.CDLL(_LIB_PATH)
-                if lib.ldt_decode_abi_version() != _ABI_VERSION:
-                    # Rebuilt from source yet still mismatched: the source
-                    # itself is a different ABI generation — don't bind.
-                    _load_failed = True
-                    return None
-            lib.ldt_decode_batch.restype = ctypes.c_int
-            lib.ldt_decode_batch.argtypes = [
-                ctypes.POINTER(ctypes.c_char_p),
-                ctypes.POINTER(ctypes.c_size_t),
-                ctypes.c_int,
-                ctypes.c_int,
-                ctypes.POINTER(ctypes.c_uint8),
-                ctypes.POINTER(ctypes.c_uint8),
-                ctypes.c_int,
-            ]
-            lib.ldt_decode_batch_offsets.restype = ctypes.c_int
-            lib.ldt_decode_batch_offsets.argtypes = [
-                ctypes.c_void_p,  # values buffer
-                ctypes.POINTER(ctypes.c_int64),  # offsets[n+1]
-                ctypes.c_int,
-                ctypes.c_int,
-                ctypes.POINTER(ctypes.c_uint8),
-                ctypes.POINTER(ctypes.c_uint8),
-                ctypes.c_int,
-            ]
-            _lib = lib
-        except OSError:
-            _load_failed = True
+        lib.ldt_decode_batch.restype = ctypes.c_int
+        lib.ldt_decode_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int,
+        ]
+        lib.ldt_decode_batch_offsets.restype = ctypes.c_int
+        lib.ldt_decode_batch_offsets.argtypes = [
+            ctypes.c_void_p,  # values buffer
+            ctypes.POINTER(ctypes.c_int64),  # offsets[n+1]
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int,
+        ]
+        _lib = lib
         return _lib
 
 

@@ -997,6 +997,7 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             break
 
     results["history"] = history
+    results["steps"] = global_step  # train steps executed this run
     results["total_time"] = time.perf_counter() - total_start
     results["start_epoch"] = start_epoch
     if config.eval_at_end:

@@ -92,13 +92,14 @@ def test_cli_data_and_eval_flags(monkeypatch):
     cli.main([
         "--dataset_path", "/d", "--no_wandb", "--loader_style", "map",
         "--filter", "label < 5", "--val_fraction", "0.1",
-        "--data_echo", "4", "--log_grad_norm",
+        "--data_echo", "4", "--log_grad_norm", "--max_steps", "7",
     ])
     config = captured["config"]
     assert config.filter == "label < 5"
     assert config.val_fraction == 0.1
     assert config.data_echo == 4
     assert config.log_grad_norm is True
+    assert config.max_steps == 7
 
 
 def test_top_level_api_exports():

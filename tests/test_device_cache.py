@@ -97,6 +97,7 @@ def test_max_steps_stops_early(image_dataset, monkeypatch):
         _cfg(image_dataset.uri, epochs=5, device_cache=False, max_steps=3)
     )
     assert calls["n"] == 3
+    assert results["steps"] == 3
     assert np.isfinite(results["loss"])
     assert results["epoch"] == 0  # stopped inside the first epoch
 

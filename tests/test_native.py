@@ -20,6 +20,27 @@ def _jpeg(arr):
     return b.getvalue()
 
 
+def test_readonly_package_dir_falls_back_to_cache(tmp_path, monkeypatch):
+    """A system pip install puts the package in a read-only directory; the
+    lazy g++ build must fall back to the per-user cache instead of silently
+    losing the native decoder."""
+    from lance_distributed_training_tpu.native import jpeg as jmod
+
+    cache = tmp_path / "cache" / "_ldt_decode_abi_test.so"
+    monkeypatch.setattr(jmod, "_LIB_PATH", "/proc/ldt-unwritable/_x.so")
+    monkeypatch.setattr(jmod, "_CACHE_LIB", str(cache))
+    monkeypatch.setattr(jmod, "_lib", None)
+    monkeypatch.setattr(jmod, "_load_failed", False)
+    lib = jmod._load()
+    assert lib is not None
+    assert cache.exists()
+    # The fallback library decodes correctly end to end.
+    rng = np.random.default_rng(0)
+    payload = _jpeg((rng.random((48, 48, 3)) * 255).astype(np.uint8))
+    out, failed = jmod.batch_decode_jpeg([payload], 32)
+    assert out.shape == (1, 32, 32, 3) and not failed.any()
+
+
 def test_decode_shapes_and_determinism():
     rng = np.random.default_rng(0)
     payloads = [_jpeg((rng.random((64, 64, 3)) * 255).astype(np.uint8))
