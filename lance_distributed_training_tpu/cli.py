@@ -73,6 +73,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no_wandb", action="store_true")
     p.add_argument("--model_name", type=str, default=None,
                    help="default per task: resnet50 / bert_base / clip_resnet50_bert")
+    p.add_argument("--pretrained", type=str, default=None,
+                   help="path to a torch.save'd torchvision ResNet "
+                        "state_dict: fine-tune from its backbone weights "
+                        "(the reference's pretrained-ResNet50 task shape)")
     p.add_argument("--image_size", type=int, default=224)
     p.add_argument("--seq_len", type=int, default=128)
     p.add_argument("--vocab_size", type=int, default=None,
@@ -232,6 +236,7 @@ def main(argv=None) -> dict:
         no_ddp=args.no_ddp,
         no_wandb=args.no_wandb,
         model_name=args.model_name,
+        pretrained=args.pretrained,
         image_size=args.image_size,
         seq_len=args.seq_len,
         vocab_size=args.vocab_size,

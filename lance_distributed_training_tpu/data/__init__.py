@@ -19,6 +19,7 @@ from .decode import (  # noqa: F401
 from .pipeline import (  # noqa: F401
     DataPipeline,
     MapStylePipeline,
+    make_eval_pipeline,
     make_train_pipeline,
     make_map_style_pipeline,
 )

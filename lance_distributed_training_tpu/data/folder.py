@@ -17,18 +17,46 @@ from typing import Callable, Iterator, Optional
 import numpy as np
 
 from .authoring import _folder_samples
-from .samplers import distributed_index_batches
+from .samplers import distributed_index_batches, sharded_batch_plan
 
-__all__ = ["FolderDataPipeline"]
+__all__ = ["FolderDataPipeline", "read_sample_batch"]
+
+
+def read_sample_batch(samples, idx_batch: np.ndarray):
+    """Read files ``samples[i] for i in idx_batch`` into the columnar batch
+    schema ``{image: binary, label: int64}`` — the shared file-side read used
+    by both the train pipeline and the full-coverage eval loader."""
+    import pyarrow as pa
+
+    payloads, labels = [], []
+    for i in idx_batch:
+        path, label = samples[int(i)]
+        with open(path, "rb") as f:
+            payloads.append(f.read())
+        labels.append(label)
+    return pa.table(
+        {"image": pa.array(payloads, pa.binary()),
+         "label": pa.array(labels, pa.int64())}
+    )
 
 
 class FolderDataPipeline:
     """Distributed file-reading pipeline over an image-folder tree.
 
-    Map-style semantics (``DistributedSampler``-equivalent index sharding with
-    per-epoch reshuffle, mirroring ``torch_version/map_style.py:59-61``); the
-    decode hook receives ``{image: list[bytes], label: np.ndarray}`` shaped
-    like a columnar read, so the SAME decoder classes work on both arms.
+    Both torchvision twins, selected by ``loader_style``:
+
+    - ``"map"``: ``DistributedSampler``-equivalent per-index sharding with
+      per-epoch reshuffle, mirroring ``torch_version/map_style.py:59-61``.
+    - ``"iterable"``: sequential file-walk semantics mirroring
+      ``torch_version/iter_style.py:17-50`` — contiguous batches of the
+      walk-ordered file list dealt round-robin across processes (the same
+      batch-range plan as the columnar iterable arm, so the columnar-vs-files
+      A/B isolates storage, not sampling); ``shuffle`` permutes batch ORDER
+      only, rows within a batch keep walk order.
+
+    Either way the decode hook receives ``{image: list[bytes], label:
+    np.ndarray}`` shaped like a columnar read, so the SAME decoder classes
+    work on both arms.
     """
 
     def __init__(
@@ -40,6 +68,7 @@ class FolderDataPipeline:
         decode_fn: Callable,
         device_put_fn: Optional[Callable] = None,
         *,
+        loader_style: str = "map",
         shuffle: bool = True,
         seed: int = 0,
         epoch: int = 0,
@@ -51,6 +80,11 @@ class FolderDataPipeline:
         self.samples, self.classes = _folder_samples(root)
         if not self.samples:
             raise ValueError(f"no images under {root}")
+        if loader_style not in ("map", "iterable"):
+            raise ValueError(
+                f"loader_style must be 'map' or 'iterable', got {loader_style!r}"
+            )
+        self.loader_style = loader_style
         self.batch_size = batch_size
         self.process_index = process_index
         self.process_count = process_count
@@ -72,6 +106,20 @@ class FolderDataPipeline:
         return len(self.classes)
 
     def _index_batches(self) -> list[np.ndarray]:
+        if self.loader_style == "iterable":
+            plan = sharded_batch_plan(
+                [len(self.samples)],
+                self.batch_size,
+                self.process_index,
+                self.process_count,
+                shuffle=self.shuffle,
+                seed=self.seed,
+                epoch=self.epoch,
+            )
+            return [
+                np.concatenate([np.arange(r.start, r.stop) for r in ranges])
+                for ranges in plan
+            ]
         return distributed_index_batches(
             len(self.samples),
             self.batch_size,
@@ -87,18 +135,7 @@ class FolderDataPipeline:
         return len(self._index_batches())
 
     def _read(self, idx_batch: np.ndarray):
-        import pyarrow as pa
-
-        payloads, labels = [], []
-        for i in idx_batch:
-            path, label = self.samples[int(i)]
-            with open(path, "rb") as f:
-                payloads.append(f.read())
-            labels.append(label)
-        return pa.table(
-            {"image": pa.array(payloads, pa.binary()),
-             "label": pa.array(labels, pa.int64())}
-        )
+        return read_sample_batch(self.samples, idx_batch)
 
     def __iter__(self) -> Iterator[dict]:
         from .pipeline import DataPipeline

@@ -37,7 +37,7 @@ from .samplers import (
     make_plan,
 )
 
-__all__ = ["DataPipeline", "MapStylePipeline", "make_train_pipeline", "make_map_style_pipeline"]
+__all__ = ["DataPipeline", "MapStylePipeline", "make_train_pipeline", "make_map_style_pipeline", "make_eval_pipeline"]
 
 _SENTINEL = object()
 
@@ -322,6 +322,57 @@ def make_train_pipeline(
     return DataPipeline(dataset, plan, decode_fn, device_put_fn, prefetch,
                         read_fn=_with_columns(_range_read, columns),
                         workers=workers, producers=producers)
+
+
+def make_eval_pipeline(
+    read_fn: Callable[[np.ndarray], pa.Table],
+    num_rows: int,
+    global_batch: int,
+    process_index: int,
+    process_count: int,
+    decode_fn: Callable,
+    device_put_fn: Optional[Callable] = None,
+    *,
+    prefetch: int = 2,
+    producers: int = 1,
+    index_pool: Optional[np.ndarray] = None,
+) -> DataPipeline:
+    """Full-coverage eval loader: every row exactly once, ONE compiled shape.
+
+    Train loaders either drop the ragged tail (batch plans) or keep it ragged
+    and pay one extra XLA compile per eval shape (``full_scan_plan``). Here
+    the tail is padded back to a full global batch by wrap-around rows and
+    each yielded batch carries ``_weight`` ∈ {0,1}^[B] marking the pads;
+    ``make_eval_step`` weights the per-example metric with it, so eval covers
+    100% of rows at a single static batch shape (the reference's eval simply
+    iterates a DataLoader, ``modelling/classification.py:20-32`` — ragged
+    tails are free under eager torch, not under jit).
+
+    ``read_fn`` maps an index array to an Arrow table — ``Dataset.take`` for
+    the columnar arm, the file-reading path for the folder arm — so both
+    storage arms share this loader. Decode runs on producer threads (eval is
+    a single pass; no worker-pool protocol needed).
+    """
+    from .samplers import padded_eval_index_batches
+
+    total = num_rows if index_pool is None else len(index_pool)
+    plan = padded_eval_index_batches(
+        total, global_batch, process_index, process_count,
+        index_pool=index_pool,
+    )
+
+    def _read(_ds, entry):
+        idx, weights = entry
+        return read_fn(idx), weights
+
+    def _decode(payload):
+        table, weights = payload
+        out = dict(decode_fn(table))
+        out["_weight"] = weights
+        return out
+
+    return DataPipeline(None, plan, _decode, device_put_fn, prefetch,
+                        read_fn=_read, producers=producers)
 
 
 class MapStylePipeline:

@@ -41,6 +41,7 @@ __all__ = [
     "sharded_fragment_plan",
     "distributed_indices",
     "distributed_index_batches",
+    "padded_eval_index_batches",
     "assert_equal_step_counts",
     "make_plan",
 ]
@@ -275,6 +276,50 @@ def distributed_index_batches(
     n = len(indices)
     steps = n // batch_size if drop_last else -(-n // batch_size)
     return [indices[s * batch_size : (s + 1) * batch_size] for s in range(steps)]
+
+
+def padded_eval_index_batches(
+    num_rows: int,
+    global_batch: int,
+    process_index: int,
+    process_count: int,
+    *,
+    index_pool: Optional[np.ndarray] = None,
+) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Full-coverage eval plan: every row exactly once, ONE compiled shape.
+
+    The train-side samplers trade the ragged tail away (batch plans drop it;
+    ``full_scan_plan`` keeps it ragged, costing one extra XLA compile per
+    eval shape). Eval wants neither: the tail batch is padded back to
+    ``global_batch`` by wrap-around rows carried with weight 0.0, so the
+    weighted metric counts each real row exactly once and the jitted eval
+    step sees a single static shape. Every process gets the same batch
+    count by construction (the deadlock invariant).
+
+    Returns THIS process's ``(indices, weights)`` per step: its
+    ``global_batch // process_count`` slice of each global batch. With
+    ``index_pool`` (row filters / val splits) positions index into the pool.
+    """
+    _check_topology(process_index, process_count)
+    per_process, rem = divmod(global_batch, process_count)
+    if rem:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by "
+            f"{process_count} processes"
+        )
+    if num_rows <= 0:
+        return []
+    n_batches = -(-num_rows // global_batch)
+    padded = n_batches * global_batch
+    pos = np.arange(padded) % num_rows
+    idx = index_pool[pos] if index_pool is not None else pos
+    weights = (np.arange(padded) < num_rows).astype(np.float32)
+    out = []
+    for b in range(n_batches):
+        lo = b * global_batch + process_index * per_process
+        hi = lo + per_process
+        out.append((idx[lo:hi], weights[lo:hi]))
+    return out
 
 
 def make_plan(

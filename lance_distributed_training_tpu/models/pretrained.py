@@ -1,0 +1,192 @@
+"""torchvision → Flax pretrained-weight import (transfer learning).
+
+The reference fine-tunes a *pretrained* torchvision ResNet-50 — its model
+layer is ``models.resnet50(weights=ResNet50_Weights.DEFAULT)`` with a fresh
+``fc`` head (``/root/reference/modelling/classification.py:6-10``). This
+module reproduces that task shape for the Flax zoo: a torch ``state_dict``
+(torchvision key naming) converts into :class:`~.resnet.ResNet` variables —
+NCHW→HWIO kernel transposes, BN scale/bias/running stats — and the
+classifier head stays freshly initialised whenever its shape differs from
+the checkpoint's (the reference always swaps the head; matching shapes are
+imported so a 1000-class run round-trips exactly).
+
+torch is a host-side dependency only (CPU wheel in this image): it reads the
+checkpoint; everything after ``.numpy()`` is numpy/JAX. No torchvision
+needed — the key schema is data, not code.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+__all__ = ["load_torch_state_dict", "torchvision_resnet_to_flax"]
+
+# torchvision block names → (stage_sizes, flax block class name), mirroring
+# models/resnet.py's constructors.
+_STAGES = {
+    "resnet18": ((2, 2, 2, 2), "BasicBlock"),
+    "resnet34": ((3, 4, 6, 3), "BasicBlock"),
+    "resnet50": ((3, 4, 6, 3), "BottleneckBlock"),
+    "resnet101": ((3, 4, 23, 3), "BottleneckBlock"),
+    "resnet152": ((3, 8, 36, 3), "BottleneckBlock"),
+}
+
+
+def load_torch_state_dict(path: str) -> dict[str, np.ndarray]:
+    """Read a ``torch.save``'d checkpoint into ``{key: float32 ndarray}``.
+
+    Accepts both a bare ``state_dict`` and the common ``{"state_dict": ...}``
+    /  ``{"model": ...}`` wrappers; strips ``module.`` (DDP) prefixes the way
+    torch users expect.
+    """
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"pretrained checkpoint not found: {path}")
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    for wrapper in ("state_dict", "model"):
+        if isinstance(obj, dict) and wrapper in obj and isinstance(
+            obj[wrapper], dict
+        ):
+            obj = obj[wrapper]
+    out = {}
+    for k, v in obj.items():
+        if k.startswith("module."):
+            k = k[len("module."):]
+        if hasattr(v, "numpy"):
+            out[k] = np.asarray(v.detach().to(torch.float32).numpy())
+    return out
+
+
+def _t_conv(w: np.ndarray) -> np.ndarray:
+    """torch OIHW conv weight → Flax HWIO kernel."""
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+class _Importer:
+    """Tracks which checkpoint keys were consumed; fails loudly on shape or
+    coverage mismatches instead of silently fine-tuning random weights."""
+
+    def __init__(self, sd: Mapping[str, np.ndarray]):
+        self.sd = dict(sd)
+        self.used: set[str] = set()
+
+    def take(self, key: str, expect_shape, transform=None) -> np.ndarray:
+        if key not in self.sd:
+            raise KeyError(f"pretrained checkpoint is missing {key!r}")
+        val = self.sd[key]
+        if transform is not None:
+            val = transform(val)
+        if tuple(val.shape) != tuple(expect_shape):
+            raise ValueError(
+                f"{key!r}: checkpoint shape {tuple(val.shape)} != model "
+                f"shape {tuple(expect_shape)} (after layout transform)"
+            )
+        self.used.add(key)
+        return val
+
+    def unused(self) -> list[str]:
+        return sorted(
+            k for k in self.sd
+            if k not in self.used and not k.endswith("num_batches_tracked")
+        )
+
+
+def _import_bn(imp: _Importer, prefix: str, params: dict, stats: dict) -> None:
+    params["scale"] = imp.take(f"{prefix}.weight", params["scale"].shape)
+    params["bias"] = imp.take(f"{prefix}.bias", params["bias"].shape)
+    stats["mean"] = imp.take(f"{prefix}.running_mean", stats["mean"].shape)
+    stats["var"] = imp.take(f"{prefix}.running_var", stats["var"].shape)
+
+
+def torchvision_resnet_to_flax(
+    state_dict: Mapping[str, np.ndarray],
+    variables: Mapping[str, Any],
+    model_name: str = "resnet50",
+) -> dict[str, Any]:
+    """Map a torchvision ResNet ``state_dict`` onto Flax ``variables``.
+
+    ``variables`` is the initialised ``{"params": ..., "batch_stats": ...}``
+    tree from :class:`~.resnet.ResNet`; the return value has the same
+    structure with imported float32 values. The ``head`` kernel/bias import
+    only when shapes match (1000-class checkpoint → 1000-class model);
+    otherwise they keep their fresh initialisation — the reference's
+    "swap fc for num_classes" (``modelling/classification.py:9``).
+    """
+    if model_name not in _STAGES:
+        raise ValueError(
+            f"pretrained import supports {sorted(_STAGES)}; got {model_name!r}"
+        )
+    stage_sizes, block_name = _STAGES[model_name]
+    imp = _Importer(state_dict)
+    # Deep-copy the tree structure with plain dicts (inputs may be frozen).
+    params = jax.tree_util.tree_map(np.asarray, _to_dict(variables["params"]))
+    stats = jax.tree_util.tree_map(
+        np.asarray, _to_dict(variables["batch_stats"])
+    )
+
+    params["conv_init"]["kernel"] = imp.take(
+        "conv1.weight", params["conv_init"]["kernel"].shape, _t_conv
+    )
+    _import_bn(imp, "bn1", params["norm_init"], stats["norm_init"])
+
+    # torchvision Bottleneck/BasicBlock sublayer order == the Flax blocks'
+    # compact instantiation order, so conv{k} ↔ Conv_{k-1}, bn{k} ↔
+    # BatchNorm_{k-1}, downsample.{0,1} ↔ {conv_proj, norm_proj}.
+    n_convs = 3 if block_name == "BottleneckBlock" else 2
+    flat = 0
+    for stage, count in enumerate(stage_sizes):
+        for block in range(count):
+            t_prefix = f"layer{stage + 1}.{block}"
+            f_name = f"{block_name}_{flat}"
+            bp, bs = params[f_name], stats[f_name]
+            for k in range(n_convs):
+                bp[f"Conv_{k}"]["kernel"] = imp.take(
+                    f"{t_prefix}.conv{k + 1}.weight",
+                    bp[f"Conv_{k}"]["kernel"].shape,
+                    _t_conv,
+                )
+                _import_bn(
+                    imp, f"{t_prefix}.bn{k + 1}",
+                    bp[f"BatchNorm_{k}"], bs[f"BatchNorm_{k}"],
+                )
+            if "conv_proj" in bp:
+                bp["conv_proj"]["kernel"] = imp.take(
+                    f"{t_prefix}.downsample.0.weight",
+                    bp["conv_proj"]["kernel"].shape,
+                    _t_conv,
+                )
+                _import_bn(
+                    imp, f"{t_prefix}.downsample.1",
+                    bp["norm_proj"], bs["norm_proj"],
+                )
+            flat += 1
+
+    # Head: torch fc.weight is [out, in]; Flax kernel is [in, out].
+    head = params["head"]
+    fc_w = state_dict.get("fc.weight")
+    if fc_w is not None and fc_w.T.shape == head["kernel"].shape:
+        head["kernel"] = imp.take("fc.weight", head["kernel"].shape,
+                                  np.transpose)
+        head["bias"] = imp.take("fc.bias", head["bias"].shape)
+    else:
+        # Fresh head (fine-tuning); mark consumed so coverage stays clean.
+        imp.used.update(k for k in ("fc.weight", "fc.bias") if k in imp.sd)
+
+    leftover = imp.unused()
+    if leftover:
+        raise ValueError(
+            f"pretrained checkpoint has {len(leftover)} unmapped keys "
+            f"(wrong architecture for {model_name}?): {leftover[:8]}..."
+        )
+    return {"params": params, "batch_stats": stats}
+
+
+def _to_dict(tree):
+    if isinstance(tree, Mapping):
+        return {k: _to_dict(v) for k, v in tree.items()}
+    return tree

@@ -38,10 +38,14 @@ class BasicBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        # Explicit symmetric padding: XLA's SAME pads (0,1) under stride 2,
+        # torchvision pads (1,1) — symmetric keeps imported pretrained
+        # weights numerically exact (models/pretrained.py).
+        y = self.conv(self.filters, (3, 3), self.strides,
+                      padding=[(1, 1), (1, 1)])(x)
         y = self.norm()(y)
         y = self.act(y)
-        y = self.conv(self.filters, (3, 3))(y)
+        y = self.conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)])(y)
         y = self.norm(scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
             residual = self.conv(self.filters, (1, 1), self.strides, name="conv_proj")(
@@ -66,7 +70,9 @@ class BottleneckBlock(nn.Module):
         y = self.conv(self.filters, (1, 1))(x)
         y = self.norm()(y)
         y = self.act(y)
-        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        # Symmetric padding for torchvision parity (see BasicBlock).
+        y = self.conv(self.filters, (3, 3), self.strides,
+                      padding=[(1, 1), (1, 1)])(y)
         y = self.norm()(y)
         y = self.act(y)
         y = self.conv(self.filters * 4, (1, 1))(y)
@@ -89,11 +95,16 @@ class ResNet(nn.Module):
     num_classes: int
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    # float32 params are the stable default; bfloat16 halves param +
+    # optimizer-state HBM and the per-step weight traffic (a deliberate
+    # perf/stability trade the bench sweep measures explicitly).
+    param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(
-            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32
+            nn.Conv, use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype,
         )
         norm = partial(
             nn.BatchNorm,
@@ -101,7 +112,7 @@ class ResNet(nn.Module):
             momentum=0.9,
             epsilon=1e-5,
             dtype=self.dtype,
-            param_dtype=jnp.float32,
+            param_dtype=self.param_dtype,
         )
         act = nn.relu
 

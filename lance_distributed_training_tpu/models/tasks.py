@@ -65,14 +65,23 @@ def _classifiers() -> dict:
 
 
 def _classification_task(num_classes: int, model_name: str, image_size: int,
-                         augment: bool) -> Task:
+                         augment: bool, param_dtype=None) -> Task:
     registry = _classifiers()
     try:
-        model = registry[model_name](num_classes=num_classes)
+        ctor = registry[model_name]
     except KeyError:
         raise ValueError(
             f"Invalid model name: {model_name} (have {sorted(registry)})"
         ) from None
+    kwargs = {"num_classes": num_classes}
+    if param_dtype is not None:
+        if model_name not in _RESNETS:
+            raise ValueError(
+                f"param_dtype override supports the ResNet family; got "
+                f"{model_name!r}"
+            )
+        kwargs["param_dtype"] = param_dtype
+    model = ctor(**kwargs)
 
     def init_variables(rng):
         return model.init(
@@ -413,13 +422,16 @@ def get_task(
     pipeline_parallelism: int = 1,
     pp_microbatches: int = 4,
     mesh=None,
+    param_dtype=None,
 ) -> Task:
     """``vocab_size=None`` means "the model's own default" (bert_*: 30522,
     clip_tiny: 1000, clip_resnet50_bert: 30522); explicit values always
-    apply verbatim."""
+    apply verbatim. ``param_dtype`` overrides the parameter/optimizer-state
+    dtype (ResNet family only; e.g. ``jnp.bfloat16`` halves weight HBM)."""
     if task_type == "classification":
         return _classification_task(
-            num_classes, model_name or "resnet50", image_size, augment
+            num_classes, model_name or "resnet50", image_size, augment,
+            param_dtype=param_dtype,
         )
     if task_type == "masked_lm":
         if pipeline_parallelism > 1:

@@ -113,6 +113,11 @@ class TrainConfig:
     no_ddp: bool = False  # single-device escape hatch (lance_iterable.py:145)
     no_wandb: bool = False  # lance_iterable.py:146
     model_name: Optional[str] = None  # default per task (resnet50 / bert_base / clip)
+    pretrained: Optional[str] = None  # path to a torch.save'd torchvision
+    # ResNet state_dict: backbone weights + BN stats import into the Flax
+    # model (models/pretrained.py); the head stays fresh unless its shape
+    # matches — the reference's transfer-learning task shape
+    # (modelling/classification.py:6-10). Classification/ResNet only.
     image_size: int = 224
     seq_len: int = 128  # masked_lm / contrastive text length
     vocab_size: Optional[int] = None  # None = the model's own default
@@ -397,36 +402,66 @@ def make_train_step(task: Task, mesh, *, donate: bool = True,
 
 
 def make_eval_step(task: Task, mesh, *, state_sharding=None, batch_spec=None):
+    """Returns ``step(state, batch) -> (metric_sum, example_count)``.
+
+    A batch carrying ``_weight`` (the full-coverage eval loader's pad mask,
+    ``make_eval_pipeline``) contributes ``(metric·w).sum(), w.sum()`` so
+    wrap-around pad rows count zero; otherwise the count is the static batch
+    size. Two jitted variants — the weight array is rank-1 regardless of the
+    task's batch rank, so it takes its own ``P('data')`` sharding rather
+    than the batch-wide spec."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
     if batch_spec is not None:
-        from jax.sharding import NamedSharding
-
         data = NamedSharding(mesh, batch_spec)
     else:
         data = batch_sharding(mesh)
+    wsharding = NamedSharding(mesh, P("data"))
+
+    def _metric(state: TrainState, batch):
+        outputs, _ = task.forward(_variables(state), batch, False, None)
+        return task.metric(outputs, batch)
+
+    def _plain(state: TrainState, batch):
+        m = _metric(state, batch)
+        return m.sum(), jnp.asarray(m.shape[0], jnp.float32)
+
+    def _weighted(state: TrainState, batch, w):
+        m = _metric(state, batch)
+        return (m * w).sum(), w.sum()
+
+    plain = jax.jit(_plain, in_shardings=(state_sh, data),
+                    out_shardings=repl)
+    weighted = jax.jit(_weighted, in_shardings=(state_sh, data, wsharding),
+                       out_shardings=repl)
 
     def step(state: TrainState, batch):
-        outputs, _ = task.forward(_variables(state), batch, False, None)
-        return task.metric(outputs, batch).sum()
+        batch = dict(batch)
+        w = batch.pop("_weight", None)
+        if w is None:
+            return plain(state, batch)
+        return weighted(state, batch, w)
 
-    return jax.jit(step, in_shardings=(state_sh, data), out_shardings=repl)
+    return step
 
 
 def evaluate(state, loader, eval_step) -> float:
     """Mean per-example metric over a loader — the ``evaluate`` equivalent
     (``/root/reference/modelling/classification.py:20-32``). The per-batch
-    sums accumulate ON DEVICE (async dispatch); the only host sync is the
-    final ``float()`` — unlike the reference's per-step ``.item()``
-    (``lance_iterable.py:115``) this never serialises eval on D2H."""
-    correct = None
-    total = 0
+    (sum, count) pairs accumulate ON DEVICE (async dispatch); the only host
+    sync is the final ``float()`` — unlike the reference's per-step
+    ``.item()`` (``lance_iterable.py:115``) this never serialises eval on
+    D2H. Pad rows from the full-coverage eval loader carry weight 0 in both
+    the sum and the count."""
+    num = None
+    den = None
     batches = 0
     for batch in loader:
-        part = eval_step(state, batch)
-        correct = part if correct is None else correct + part
-        first = jax.tree_util.tree_leaves(batch)[0]
-        total += first.shape[0]
+        part, count = eval_step(state, batch)
+        num = part if num is None else num + part
+        den = count if den is None else den + count
         batches += 1
         if batches % 32 == 0:
             # Bound dispatch depth: each in-flight eval step pins its batch
@@ -434,8 +469,11 @@ def evaluate(state, loader, eval_step) -> float:
             # serialising every step as the reference's .item() did. (Fetch,
             # not block_until_ready — the latter returns early on the
             # tunneled TPU backend.)
-            _ = float(correct)
-    return float(correct) / total if total else 0.0
+            _ = float(num)
+    if den is None:
+        return 0.0
+    total = float(den)
+    return float(num) / total if total else 0.0
 
 
 def _decoder_for(config: TrainConfig):
@@ -502,6 +540,11 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             process_count,
             decode,
             put,
+            loader_style=config.loader_style,
+            # Map-style always reshuffles (DistributedSampler semantics);
+            # the iterable arm's batch-order shuffle is opt-in, matching the
+            # columnar iterable path.
+            shuffle=True if config.loader_style == "map" else config.shuffle,
             seed=config.seed,
             epoch=epoch,
             prefetch=config.prefetch,
@@ -616,6 +659,95 @@ def _split_val_pool(config: TrainConfig, dataset, index_pool):
     return np.sort(pool[perm[n_val:]]), np.sort(pool[perm[:n_val]])
 
 
+def _build_eval_loader(config: TrainConfig, dataset, mesh, index_pool=None):
+    """Full-coverage eval loader: every row exactly once per eval, the tail
+    batch padded by wrap-around rows carried with ``_weight`` 0.0 — single
+    compiled batch shape, equal step counts on every process (r3 verdict:
+    batch-sampler eval dropped the tail; full_scan's ragged tail recompiled).
+    Training's ``loader_style``/``sampler_type`` don't apply here: eval
+    coverage is exact by construction on both storage arms."""
+    from .data.pipeline import make_eval_pipeline
+
+    process_index, process_count = process_topology()
+    decode = _decoder_for(config)
+    put = partial(
+        make_global_batch,
+        mesh=mesh,
+        seq_axis="seq" if config.seq_parallelism > 1 else None,
+    )
+    if config.data_format == "folder":
+        from .data.authoring import _folder_samples
+        from .data.folder import read_sample_batch
+
+        samples, _ = _folder_samples(config.dataset_path)
+
+        def read_fn(idx):
+            return read_sample_batch(samples, idx)
+
+        total = len(samples)
+    else:
+        columns = getattr(decode, "required_columns", None)
+
+        def read_fn(idx):
+            return dataset.take(idx, columns=columns)
+
+        total = dataset.count_rows()
+        if config.filter and index_pool is None:
+            index_pool = dataset.filter_indices(config.filter)
+    return make_eval_pipeline(
+        read_fn,
+        total,
+        config.batch_size,
+        process_index,
+        process_count,
+        decode,
+        put,
+        prefetch=config.prefetch,
+        producers=config.producer_threads,
+        index_pool=index_pool,
+    )
+
+
+def _per_device_batch_bytes(batch) -> int:
+    """Bytes ONE device keeps resident for a cached batch.
+
+    Cached batches are global ``jax.Array``s sharded over the mesh, so the
+    HBM cost per chip is the device's shard — not the logical global size
+    (which would wrongly reject an ~11 GB decoded FOOD101 on an 8-chip mesh
+    whose per-chip share is ~1.4 GB). Per leaf this takes the max of any one
+    local device's resident bytes, so replicated leaves count at full size
+    and uneven layouts count their worst device.
+    """
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(batch):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            per_dev: dict = {}
+            for s in shards:
+                per_dev[s.device] = per_dev.get(s.device, 0) + s.data.nbytes
+            total += max(per_dev.values())
+        else:
+            # Host numpy leaf (no_ddp path): lives whole on the one device.
+            total += leaf.nbytes
+    return total
+
+
+def _device_cache_budget_bytes(config: TrainConfig) -> float:
+    """Per-device cache budget: ``device_cache_gb``, further clamped to the
+    backend-reported free HBM (``bytes_limit - bytes_in_use`` with 10%
+    headroom for activations/fragmentation) when the runtime exposes
+    ``memory_stats`` (TPU does; CPU returns None)."""
+    budget = config.device_cache_gb * 1e9
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — stats are best-effort telemetry
+        stats = None
+    if stats and stats.get("bytes_limit"):
+        free = stats["bytes_limit"] - stats.get("bytes_in_use", 0)
+        budget = min(budget, max(free, 0) * 0.9)
+    return budget
+
+
 def train(config: TrainConfig) -> dict:
     """The single training entry point. Returns final metrics."""
     if config.val_fraction:
@@ -702,6 +834,31 @@ def train(config: TrainConfig) -> dict:
         init_rng, task, config, mesh, rules,
         fsdp_axis="data" if config.fsdp else None, total_steps=total_steps,
     )
+    if config.pretrained:
+        # Transfer learning (the reference's actual training task): replace
+        # the randomly initialised backbone with the checkpoint's weights,
+        # re-committed at the state's own shardings.
+        if config.task_type != "classification":
+            raise ValueError(
+                "--pretrained imports torchvision ResNet checkpoints; task "
+                f"{config.task_type!r} has no importer"
+            )
+        from .models.pretrained import (
+            load_torch_state_dict,
+            torchvision_resnet_to_flax,
+        )
+
+        imported = torchvision_resnet_to_flax(
+            load_torch_state_dict(config.pretrained),
+            {"params": state.params, "batch_stats": state.batch_stats},
+            config.model_name or "resnet50",
+        )
+        state = state.replace(
+            params=jax.device_put(imported["params"], state_sharding.params),
+            batch_stats=jax.device_put(
+                imported["batch_stats"], state_sharding.batch_stats
+            ),
+        )
     batch_spec = (
         batch_partition_spec(2, seq_axis="seq")
         if config.seq_parallelism > 1
@@ -784,14 +941,12 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
     # the lr telemetry must count from there, not from this run's step 0.
     base_step = int(state.step)
     trace_done = False  # one profiler window per run
-    # Eval-loader selection, shared by eval_every and eval_at_end. Worker
-    # pools are bound to the TRAIN dataset URI; a held-out val DATASET must
-    # not reuse them, while a val_fraction split (same dataset) can.
+    # Eval-loader selection, shared by eval_every and eval_at_end.
     # Pool precedence: val_fraction split → train pool (eval over the train
     # loader) → a val dataset resolves its OWN filter pool via the fallback
-    # in _build_loader.
+    # in _build_eval_loader. (Eval decodes on producer threads, never the
+    # train worker pool — pools are bound to the TRAIN dataset URI.)
     eval_dataset = val_dataset if val_dataset is not None else dataset
-    eval_workers = worker_pool if val_dataset is None else None
     eval_pool = (
         val_pool if val_pool is not None
         else index_pool if val_dataset is None
@@ -826,19 +981,19 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 break
             if filling:
                 if not cache:
-                    per_batch = sum(
-                        leaf.nbytes
-                        for leaf in jax.tree_util.tree_leaves(batch)
-                    )
+                    per_batch = _per_device_batch_bytes(batch)
                     projected = per_batch * len(loader)
-                    if projected > config.device_cache_gb * 1e9:
+                    budget = _device_cache_budget_bytes(config)
+                    if projected > budget:
                         cache_ok = False
                         filling = False
                         logger.log(
                             {
                                 "device_cache": "disabled",
-                                "projected_gb": round(projected / 1e9, 2),
-                                "limit_gb": config.device_cache_gb,
+                                "projected_per_device_gb": round(
+                                    projected / 1e9, 3
+                                ),
+                                "limit_per_device_gb": round(budget / 1e9, 3),
                             },
                             to_wandb=False,
                         )
@@ -977,9 +1132,8 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 epoch_metrics["images_per_sec"] / config.data_echo
             )
         if config.eval_every and (epoch + 1) % config.eval_every == 0:
-            val_loader = _build_loader(
-                config, eval_dataset, mesh, epoch, eval_workers,
-                index_pool=eval_pool,
+            val_loader = _build_eval_loader(
+                config, eval_dataset, mesh, index_pool=eval_pool,
             )
             epoch_metrics["val_acc"] = evaluate(state, val_loader, eval_step)
         logger.log(epoch_metrics, step=epoch)
@@ -1009,8 +1163,8 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             if (val_dataset is not None or val_pool is not None)
             else "train_acc"
         )
-        loader = _build_loader(
-            config, eval_dataset, mesh, 0, eval_workers, index_pool=eval_pool
+        loader = _build_eval_loader(
+            config, eval_dataset, mesh, index_pool=eval_pool
         )
         results[key] = evaluate(state, loader, eval_step)
         logger.log({key: results[key]})

@@ -77,6 +77,20 @@ def test_device_cache_size_guard_falls_back(image_dataset, monkeypatch):
     assert np.isfinite(results["loss"])
 
 
+def test_device_cache_guard_counts_per_device_bytes(image_dataset, monkeypatch):
+    """The fill guard budgets per-DEVICE shard bytes, not global logical
+    bytes: a dataset ~2.3x a budget that its global size exceeds still
+    caches on the 8-device mesh because each device holds 1/8 of every
+    batch (r3 verdict: decoded FOOD101 ≈ 11.4 GB global is ~1.4 GB/chip)."""
+    calls = _count_builds(monkeypatch)
+    # 7 batches × (32·32·32·3 uint8 + 32 int64 labels) ≈ 0.69 MB global
+    # ≈ 86 KB/device. A 0.3 MB budget fails global accounting but passes
+    # per-device accounting.
+    results = train(_cfg(image_dataset.uri, epochs=2, device_cache_gb=3e-4))
+    assert calls["n"] == 1  # cache admitted: epoch 1 replays, no new loader
+    assert np.isfinite(results["loss"])
+
+
 def test_data_echo_multiplies_steps(image_dataset, monkeypatch):
     """--data_echo 3: each host batch is stepped 3 times (fresh rng per
     echo), so the optimizer sees 3x the steps of the plain plan."""

@@ -217,3 +217,29 @@ def test_column_projection_map_style(tmp_path, image_table):
     batch = next(iter(pipe))
     assert set(batch) == {"image", "label"}
     assert batch["image"].shape == (16, 32, 32, 3)
+
+
+def test_eval_pipeline_full_coverage(image_dataset):
+    """make_eval_pipeline: 100% of rows at a single compiled shape — the
+    weighted multiset of labels equals the dataset's, pads carry weight 0."""
+    import numpy as np
+
+    from lance_distributed_training_tpu.data import make_eval_pipeline
+
+    def decode(table):
+        return {"label": np.asarray(table.column("label").to_numpy())}
+
+    pipe = make_eval_pipeline(
+        lambda idx: image_dataset.take(idx), image_dataset.count_rows(),
+        64, 0, 1, decode,
+    )
+    assert len(pipe) == 4  # ceil(240/64)
+    real = []
+    for batch in pipe:
+        assert batch["label"].shape == (64,)  # single static shape
+        assert batch["_weight"].shape == (64,)
+        real.extend(batch["label"][batch["_weight"] == 1.0].tolist())
+    all_labels = image_dataset.take(
+        np.arange(image_dataset.count_rows())
+    ).column("label").to_pylist()
+    assert sorted(real) == sorted(all_labels)

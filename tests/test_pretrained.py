@@ -1,0 +1,205 @@
+"""torchvision → Flax pretrained import: layer-output parity vs torch CPU.
+
+torchvision itself is not in this image, so the tests build a minimal torch
+ResNet with the standard torchvision ``state_dict`` key schema (conv1/bn1/
+layer{1-4}.{b}.conv{k}/bn{k}/downsample.{0,1}/fc — the schema is data, not
+code) and assert the converted Flax model reproduces the torch forward pass
+on a fixed input. Reference task shape: fine-tuning a pretrained ResNet-50
+(``/root/reference/modelling/classification.py:6-10``).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from lance_distributed_training_tpu.models.pretrained import (  # noqa: E402
+    load_torch_state_dict,
+    torchvision_resnet_to_flax,
+)
+from lance_distributed_training_tpu.models.resnet import (  # noqa: E402
+    ResNet,
+    BasicBlock,
+    BottleneckBlock,
+)
+
+
+class _TorchBasicBlock(tnn.Module):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(inplanes, planes, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(planes)
+        self.relu = tnn.ReLU()
+        self.conv2 = tnn.Conv2d(planes, planes, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(planes)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(y + identity)
+
+
+class _TorchBottleneck(tnn.Module):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(inplanes, planes, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(planes)
+        self.conv2 = tnn.Conv2d(planes, planes, 3, stride, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(planes)
+        self.conv3 = tnn.Conv2d(planes, planes * 4, 1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(planes * 4)
+        self.relu = tnn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(y + identity)
+
+
+class _TorchResNet(tnn.Module):
+    def __init__(self, block, layers, num_classes=1000):
+        super().__init__()
+        self.inplanes = 64
+        self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(64)
+        self.relu = tnn.ReLU()
+        self.maxpool = tnn.MaxPool2d(3, 2, 1)
+        self.layer1 = self._make_layer(block, 64, layers[0])
+        self.layer2 = self._make_layer(block, 128, layers[1], 2)
+        self.layer3 = self._make_layer(block, 256, layers[2], 2)
+        self.layer4 = self._make_layer(block, 512, layers[3], 2)
+        self.avgpool = tnn.AdaptiveAvgPool2d(1)
+        self.fc = tnn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = tnn.Sequential(
+                tnn.Conv2d(self.inplanes, planes * block.expansion, 1,
+                           stride, bias=False),
+                tnn.BatchNorm2d(planes * block.expansion),
+            )
+        layers = [block(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        layers += [
+            block(self.inplanes, planes) for _ in range(1, blocks)
+        ]
+        return tnn.Sequential(*layers)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        x = self.avgpool(x).flatten(1)
+        return self.fc(x)
+
+
+def _randomize_bn(model, gen):
+    """Non-trivial BN params/stats — default (1,0,0,1) would hide transpose
+    or stat-mapping bugs."""
+    for m in model.modules():
+        if isinstance(m, tnn.BatchNorm2d):
+            n = m.num_features
+            with torch.no_grad():
+                m.weight.copy_(torch.from_numpy(
+                    gen.uniform(0.5, 1.5, n).astype(np.float32)))
+                m.bias.copy_(torch.from_numpy(
+                    gen.uniform(-0.3, 0.3, n).astype(np.float32)))
+                m.running_mean.copy_(torch.from_numpy(
+                    gen.uniform(-0.5, 0.5, n).astype(np.float32)))
+                m.running_var.copy_(torch.from_numpy(
+                    gen.uniform(0.5, 2.0, n).astype(np.float32)))
+
+
+def _parity_case(torch_block, layers, flax_block, stages, tmp_path):
+    gen = np.random.default_rng(0)
+    tm = _TorchResNet(torch_block, layers)
+    _randomize_bn(tm, gen)
+    tm.eval()
+    path = str(tmp_path / "ckpt.pt")
+    torch.save(tm.state_dict(), path)
+
+    x = gen.standard_normal((2, 3, 64, 64)).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(x)).numpy()
+
+    fm = ResNet(stage_sizes=stages, block_cls=flax_block, num_classes=1000,
+                dtype=jnp.float32)
+    variables = fm.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+    imported = torchvision_resnet_to_flax(
+        load_torch_state_dict(path), variables,
+        "resnet18" if flax_block is BasicBlock else "resnet50",
+    )
+    got = np.asarray(
+        fm.apply(imported, jnp.asarray(x.transpose(0, 2, 3, 1)), train=False)
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_resnet18_forward_parity(tmp_path):
+    _parity_case(_TorchBasicBlock, (2, 2, 2, 2), BasicBlock, (2, 2, 2, 2),
+                 tmp_path)
+
+
+def test_resnet50_forward_parity(tmp_path):
+    _parity_case(_TorchBottleneck, (3, 4, 6, 3), BottleneckBlock,
+                 (3, 4, 6, 3), tmp_path)
+
+
+def test_head_swap_when_classes_differ(tmp_path):
+    """num_classes != checkpoint's 1000: backbone imports, head keeps its
+    fresh init — the reference's fc swap (classification.py:9)."""
+    tm = _TorchResNet(_TorchBasicBlock, (2, 2, 2, 2))
+    path = str(tmp_path / "ckpt.pt")
+    torch.save(tm.state_dict(), path)
+    fm = ResNet(stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock,
+                num_classes=7, dtype=jnp.float32)
+    variables = fm.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+    imported = torchvision_resnet_to_flax(
+        load_torch_state_dict(path), variables, "resnet18"
+    )
+    # Backbone taken from the checkpoint...
+    np.testing.assert_allclose(
+        imported["params"]["conv_init"]["kernel"],
+        tm.state_dict()["conv1.weight"].numpy().transpose(2, 3, 1, 0),
+    )
+    # ...head kept from the fresh init, at the fine-tune shape.
+    assert imported["params"]["head"]["kernel"].shape == (512, 7)
+    np.testing.assert_allclose(
+        imported["params"]["head"]["kernel"],
+        np.asarray(variables["params"]["head"]["kernel"]),
+    )
+
+
+def test_wrong_architecture_fails_loudly(tmp_path):
+    tm = _TorchResNet(_TorchBasicBlock, (2, 2, 2, 2))
+    path = str(tmp_path / "ckpt.pt")
+    torch.save(tm.state_dict(), path)
+    fm = ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock,
+                num_classes=10, dtype=jnp.float32)
+    variables = fm.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+    with pytest.raises((KeyError, ValueError)):
+        torchvision_resnet_to_flax(
+            load_torch_state_dict(path), variables, "resnet50"
+        )
+
+
+def test_missing_file_raises():
+    with pytest.raises(FileNotFoundError):
+        load_torch_state_dict("/nonexistent/ckpt.pt")

@@ -182,3 +182,56 @@ class TestShardedBatchShuffle:
         ka = [tuple(tuple(r) for r in s) for s in a]
         kb = [tuple(tuple(r) for r in s) for s in b]
         assert ka != kb and sorted(ka) == sorted(kb)
+
+
+class TestPaddedEvalPlan:
+    """Full-coverage eval plan: every row once, one shape, equal steps."""
+
+    def test_covers_every_row_once_single_process(self):
+        from lance_distributed_training_tpu.data.samplers import (
+            padded_eval_index_batches,
+        )
+
+        plan = padded_eval_index_batches(250, 32, 0, 1)
+        assert len(plan) == 8  # ceil(250/32)
+        real, pad = [], 0
+        for idx, w in plan:
+            assert len(idx) == 32 and len(w) == 32  # single static shape
+            real.extend(idx[w == 1.0].tolist())
+            pad += int((w == 0.0).sum())
+        assert sorted(real) == list(range(250))  # each row exactly once
+        assert pad == 8 * 32 - 250
+
+    def test_multiprocess_equal_steps_disjoint_union(self):
+        from lance_distributed_training_tpu.data.samplers import (
+            padded_eval_index_batches,
+        )
+
+        plans = [padded_eval_index_batches(100, 16, p, 4) for p in range(4)]
+        assert len({len(p) for p in plans}) == 1  # equal step counts
+        real = []
+        for plan in plans:
+            for idx, w in plan:
+                assert len(idx) == 4  # per-process slice of the global batch
+                real.extend(idx[w == 1.0].tolist())
+        assert sorted(real) == list(range(100))
+
+    def test_index_pool_mapping(self):
+        from lance_distributed_training_tpu.data.samplers import (
+            padded_eval_index_batches,
+        )
+
+        pool = np.array([5, 9, 17, 40, 41])
+        plan = padded_eval_index_batches(len(pool), 4, 0, 1, index_pool=pool)
+        real = []
+        for idx, w in plan:
+            real.extend(idx[w == 1.0].tolist())
+        assert sorted(real) == sorted(pool.tolist())
+
+    def test_indivisible_batch_raises(self):
+        from lance_distributed_training_tpu.data.samplers import (
+            padded_eval_index_batches,
+        )
+
+        with pytest.raises(ValueError, match="not divisible"):
+            padded_eval_index_batches(100, 10, 0, 3)

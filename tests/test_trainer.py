@@ -102,8 +102,29 @@ def test_eval_step_counts_correct():
     state = create_train_state(jax.random.key(0), task, cfg)
     state = jax.device_put(state, replicated_sharding(mesh))
     eval_step = make_eval_step(task, mesh)
-    correct = float(eval_step(state, _image_batch(mesh, n=8)))
-    assert 0 <= correct <= 8
+    correct, count = eval_step(state, _image_batch(mesh, n=8))
+    assert 0 <= float(correct) <= 8
+    assert float(count) == 8.0
+
+
+def test_eval_step_weighted_ignores_pad_rows():
+    """A batch carrying the full-coverage loader's _weight mask counts only
+    real rows: zero-weight pads contribute to neither sum nor count."""
+    mesh = get_mesh()
+    task = get_task("classification", num_classes=10, model_name="resnet18",
+                    image_size=32)
+    cfg = TrainConfig(dataset_path="", num_classes=10)
+    state = create_train_state(jax.random.key(0), task, cfg)
+    state = jax.device_put(state, replicated_sharding(mesh))
+    eval_step = make_eval_step(task, mesh)
+    batch = _image_batch(mesh, n=8)
+    w = np.zeros(8, np.float32)
+    w[:3] = 1.0
+    batch = dict(batch)
+    batch["_weight"] = make_global_batch({"w": w}, mesh)["w"]
+    correct, count = eval_step(state, batch)
+    assert float(count) == 3.0
+    assert 0 <= float(correct) <= 3
 
 
 def test_masked_lm_task_step():
@@ -204,6 +225,25 @@ def test_train_folder_control_arm(tmp_path):
             Image.fromarray(arr).save(root / cls / f"{i}.jpg")
     cfg = small_config(str(root), data_format="folder", num_classes=2,
                       batch_size=16, epochs=1)
+    result = train(cfg)
+    assert np.isfinite(result["loss"])
+
+
+def test_train_folder_iterable_arm(tmp_path):
+    # The torch_version/iter_style.py twin: sequential-walk iterable loader
+    # through the same trainer (r3 verdict: --loader_style was silently
+    # ignored on the folder arm).
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    root = tmp_path / "imgs"
+    for cls in ["a", "b"]:
+        (root / cls).mkdir(parents=True)
+        for i in range(20):
+            arr = (rng.random((32, 32, 3)) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(root / cls / f"{i}.jpg")
+    cfg = small_config(str(root), data_format="folder", num_classes=2,
+                      batch_size=16, epochs=1, loader_style="iterable")
     result = train(cfg)
     assert np.isfinite(result["loss"])
 
