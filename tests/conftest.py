@@ -38,6 +38,27 @@ import pytest
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy trainer-loop integration (jit compiles, minutes on a "
+        "small host) — run per-round: pytest -m slow",
+    )
+    config.addinivalue_line(
+        "markers",
+        "fast: sampler/format/pipeline invariants quick enough to gate "
+        "every commit: pytest -m fast",
+    )
+
+
+def pytest_collection_modifyitems(items):
+    """Everything not explicitly marked slow is fast — the deadlock/sampler/
+    format/decode invariants that should gate every commit."""
+    for item in items:
+        if item.get_closest_marker("slow") is None:
+            item.add_marker(pytest.mark.fast)
+
+
 def make_jpeg(rng: np.ndarray, size: int = 32) -> bytes:
     """A small random JPEG payload (stands in for FOOD101 images)."""
     from PIL import Image

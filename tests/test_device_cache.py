@@ -2,10 +2,13 @@
 device in later epochs — no host decode, no H2D. Augment / MLM masking run
 inside the jitted step, so cached epochs still see fresh randomness."""
 
+import pytest
 import numpy as np
 
 import lance_distributed_training_tpu.trainer as trainer_mod
 from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+pytestmark = pytest.mark.slow  # heavy integration tier (see conftest); gate commits with -m fast
 
 
 def _cfg(path, **kw) -> TrainConfig:

@@ -15,6 +15,8 @@ from lance_distributed_training_tpu.data import (
     write_dataset,
 )
 
+pytestmark = pytest.mark.slow  # heavy integration tier (see conftest); gate commits with -m fast
+
 
 @pytest.fixture()
 def labeled_dataset(tmp_path):

@@ -14,6 +14,8 @@ from lance_distributed_training_tpu.models import (
     resnet50,
 )
 
+pytestmark = pytest.mark.slow  # heavy integration tier (see conftest); gate commits with -m fast
+
 
 def test_resnet_shapes_and_dtypes():
     model = resnet18(num_classes=7, dtype=jnp.float32)

@@ -1,6 +1,7 @@
 """Switch-MoE layer: routing/capacity semantics, expert-parallel sharding
 over the 'model' axis, aux-loss plumbing, end-to-end training."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -13,6 +14,8 @@ from lance_distributed_training_tpu.parallel.sharding import (
     TRANSFORMER_RULES,
     partition_specs,
 )
+
+pytestmark = pytest.mark.slow  # heavy integration tier (see conftest); gate commits with -m fast
 
 VOCAB, SEQ = 256, 16
 

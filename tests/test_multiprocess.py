@@ -18,6 +18,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # heavy integration tier (see conftest); gate commits with -m fast
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Child: 4 virtual CPU devices per process, 2 processes → 8 global devices.
@@ -38,6 +40,7 @@ cfg = TrainConfig(
 results = train(cfg)
 assert jax.process_count() == 2, jax.process_count()
 import math
+
 assert math.isfinite(results["loss"])
 print(f"proc{pid} OK loss={results['loss']:.4f}", flush=True)
 '''

@@ -27,6 +27,8 @@ from lance_distributed_training_tpu.trainer import (
     make_train_step,
 )
 
+pytestmark = pytest.mark.slow  # heavy integration tier (see conftest); gate commits with -m fast
+
 VOCAB, SEQ = 512, 32
 
 

@@ -4,12 +4,15 @@ gradient exactness, dp×pp composition, and a pipelined train step."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
 from lance_distributed_training_tpu.parallel.pipeline_parallel import (
     pipeline_apply,
     stack_stage_params,
 )
+
+pytestmark = pytest.mark.slow  # heavy integration tier (see conftest); gate commits with -m fast
 
 HID = 16
 

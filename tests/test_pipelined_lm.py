@@ -1,6 +1,7 @@
 """Pipelined masked-LM: the GPipe encoder stack vs sequential layer
 application, state sharding over 'pipe', and end-to-end train()."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -13,6 +14,8 @@ from lance_distributed_training_tpu.parallel.sharding import (
     partition_specs,
     rules_for_task,
 )
+
+pytestmark = pytest.mark.slow  # heavy integration tier (see conftest); gate commits with -m fast
 
 VOCAB, SEQ = 256, 16
 

@@ -22,10 +22,13 @@ from lance_distributed_training_tpu.models.pretrained import (  # noqa: E402
     torchvision_resnet_to_flax,
 )
 from lance_distributed_training_tpu.models.resnet import (  # noqa: E402
+
     ResNet,
     BasicBlock,
     BottleneckBlock,
 )
+
+pytestmark = pytest.mark.slow  # heavy integration tier (see conftest); gate commits with -m fast
 
 
 class _TorchBasicBlock(tnn.Module):

@@ -14,6 +14,8 @@ from lance_distributed_training_tpu.parallel.ring_attention import (
     make_ring_attention,
 )
 
+pytestmark = pytest.mark.slow  # heavy integration tier (see conftest); gate commits with -m fast
+
 
 def _mesh(data=2, seq=4):
     devs = np.array(jax.devices()[: data * seq]).reshape(data, seq)

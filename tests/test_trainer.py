@@ -21,6 +21,8 @@ from lance_distributed_training_tpu.trainer import (
     train,
 )
 
+pytestmark = pytest.mark.slow  # heavy integration tier (see conftest); gate commits with -m fast
+
 
 def small_config(path, **kw) -> TrainConfig:
     defaults = dict(

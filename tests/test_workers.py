@@ -16,6 +16,8 @@ from lance_distributed_training_tpu.data.workers import (
     folder_spec,
 )
 
+pytestmark = pytest.mark.slow  # heavy integration tier (see conftest); gate commits with -m fast
+
 
 def _bad_decode(table):
     raise RuntimeError("decode exploded")
