@@ -1,0 +1,169 @@
+"""Streaming-path decode scaling: where the host pipeline's ceiling is.
+
+The r3 verdict's open question: host decode (~2,000 img/s native on this
+box) sits below the device rate (~2,376 img/s), a ≥16% streaming stall
+floor — but nobody measured what caps decode or how it scales with cores.
+This script measures, on a FOOD101-shaped dataset:
+
+1. **read-only** — the serial Arrow section per batch (range read +
+   binary-column assembly; ``data/pipeline.py::_range_read``),
+2. **decode-only** — JPEG→uint8 tensor work given pre-read tables (the
+   native libjpeg path fans this over its own thread pool),
+3. **end-to-end pipeline** at ``producers`` ∈ {1, 2, 4} (producer threads
+   overlap the serial sections of different batches),
+4. an **Amdahl projection**: with the measured serial/parallel split, the
+   decode rate a C-core host sustains ≈ C·B / (t_read + t_decode) until
+   the serial read section itself saturates one core (rate ≤ B / t_read).
+
+On a 1-core host (this box) the producer sweep shows timeslicing, not
+scaling — the artifact says so via ``host_cores``; the projection rows are
+the committed model to validate on multi-core hardware. Target line: the
+projection names the smallest core count whose decode rate covers the
+device-only rate (streaming stall < 2% becomes achievable there).
+
+Runs on the CPU backend (decode is host work; no TPU claim needed).
+Prints ONE JSON line.
+
+Env: BENCH_DECODE_ROWS (default 4096), BENCH_DECODE_BATCH (512),
+BENCH_DECODE_IMAGE (224), BENCH_DEVICE_RATE_IMG_S (default 2376, the r3
+device-only ResNet-50 rate the host must cover).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from _bench_init import env_int, log  # noqa: E402
+
+METRIC = "food101_decode_scaling"
+
+
+def main() -> None:
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+    from bench import make_synthetic_food101
+    from lance_distributed_training_tpu.data import (
+        Dataset,
+        ImageClassificationDecoder,
+        make_train_pipeline,
+    )
+    from lance_distributed_training_tpu.data.pipeline import _range_read
+    from lance_distributed_training_tpu.data.samplers import sharded_batch_plan
+    from lance_distributed_training_tpu.native import native_available
+
+    rows = env_int("BENCH_DECODE_ROWS", 4096)
+    batch = env_int("BENCH_DECODE_BATCH", 512)
+    image_size = env_int("BENCH_DECODE_IMAGE", 224)
+    device_rate = float(os.environ.get("BENCH_DEVICE_RATE_IMG_S", "2376"))
+
+    tmp = tempfile.mkdtemp(prefix="ldt-decode-bench-")
+    uri = os.path.join(tmp, "food101")
+    make_synthetic_food101(uri, rows, image_size)
+    dataset = Dataset(uri)
+    decode = ImageClassificationDecoder(image_size=image_size)
+    plan = sharded_batch_plan(dataset.fragment_rows(), batch, 0, 1)
+    log(f"dataset ready: {rows} rows, {len(plan)} batches of {batch}")
+
+    # 1. Serial Arrow section: range read + (lazy) binary assembly. Two
+    # passes; the second is the warm (page-cached) figure we report.
+    for _ in range(2):
+        t0 = time.perf_counter()
+        tables = [_range_read(dataset, ranges) for ranges in plan]
+        read_wall = time.perf_counter() - t0
+    read_ms_per_batch = read_wall / len(plan) * 1e3
+
+    # 2. Decode given pre-read tables (includes Arrow binary→bytes
+    # materialisation, the decoder's own input cost).
+    decode(tables[0])  # warm the native pool / PIL imports
+    t0 = time.perf_counter()
+    for t in tables:
+        decode(t)
+    decode_wall = time.perf_counter() - t0
+    decode_ms_per_batch = decode_wall / len(plan) * 1e3
+    # len(plan)*batch, not `rows`: the plan drops the ragged tail.
+    decode_only_rate = len(plan) * batch / decode_wall
+
+    # 3. End-to-end pipeline producer sweep (host-only: no device_put).
+    sweep = []
+    for producers in (1, 2, 4):
+        pipe = make_train_pipeline(
+            dataset, "batch", batch, 0, 1, decode, device_put_fn=None,
+            prefetch=3, producers=producers,
+        )
+        it = iter(pipe)
+        next(it)  # warm
+        t0 = time.perf_counter()
+        n = 0
+        for _ in it:
+            n += 1
+        wall = time.perf_counter() - t0
+        sweep.append({
+            "producers": producers,
+            "images_per_sec": round(n * batch / wall, 1),
+        })
+        log(f"producers={producers}: {n * batch / wall:.0f} img/s")
+
+    # 4. Amdahl projection. Per batch: t_read serial (one reader at a time
+    # saturates before parallel decode does only if t_read dominates),
+    # t_decode parallelisable across cores. With C cores and ≥C producers:
+    # rate ≈ min(C·B/(t_read+t_decode), B/t_read_serial_floor). The serial
+    # floor uses t_read alone: reads from different batches can overlap in
+    # different producer threads, but the GIL-held slice of _range_read
+    # (python-level concat/assembly) serialises; treating ALL of t_read as
+    # GIL-serial makes the floor conservative.
+    t_r = read_ms_per_batch / 1e3
+    t_d = decode_ms_per_batch / 1e3
+    projection = []
+    cover = None
+    for cores in (1, 2, 4, 8, 16):
+        rate = min(cores * batch / (t_r + t_d), batch / t_r)
+        projection.append({
+            "cores": cores,
+            "projected_images_per_sec": round(rate, 0),
+            "covers_device_rate": rate >= device_rate,
+        })
+        if cover is None and rate >= device_rate:
+            cover = cores
+
+    result = {
+        "metric": METRIC,
+        "value": round(decode_only_rate, 1),
+        "unit": "images/sec_host_decode",
+        "vs_baseline": round(decode_only_rate / device_rate, 3),
+        "host_cores": os.cpu_count(),
+        "native_decode": bool(native_available()),
+        "image_size": image_size,
+        "batch": batch,
+        "rows": rows,
+        "read_ms_per_batch": round(read_ms_per_batch, 2),
+        "decode_ms_per_batch": round(decode_ms_per_batch, 2),
+        "serial_read_fraction": round(t_r / (t_r + t_d), 4),
+        "producer_sweep": sweep,
+        "amdahl_projection": projection,
+        "device_rate_to_cover_img_s": device_rate,
+        "min_cores_covering_device_rate": cover,
+        "note": (
+            "producer sweep on a 1-core host shows timeslicing, not "
+            "scaling; the projection is the committed model — validate on "
+            "multi-core hardware. Serial floor conservatively counts the "
+            "whole Arrow read as GIL-serial."
+            if os.cpu_count() == 1 else
+            "multi-core host: producer sweep is a real scaling measurement"
+        ),
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

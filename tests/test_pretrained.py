@@ -22,7 +22,6 @@ from lance_distributed_training_tpu.models.pretrained import (  # noqa: E402
     torchvision_resnet_to_flax,
 )
 from lance_distributed_training_tpu.models.resnet import (  # noqa: E402
-
     ResNet,
     BasicBlock,
     BottleneckBlock,
