@@ -183,9 +183,19 @@ def test_val_fraction_composes_with_filter(image_dataset, monkeypatch):
     def recording_init(self, *args, **kw):
         original_init(self, *args, **kw)
         if self.index_pool is not None:
-            pools.append(np.asarray(self.index_pool))
+            pools.append(("train", np.asarray(self.index_pool)))
 
     monkeypatch.setattr(MapStylePipeline, "__init__", recording_init)
+    # Eval runs through the full-coverage loader, not MapStylePipeline —
+    # record the val pool at its builder.
+    original_eval = trainer_mod._build_eval_loader
+
+    def recording_eval(config, dataset, mesh, index_pool=None):
+        if index_pool is not None:
+            pools.append(("val", np.asarray(index_pool)))
+        return original_eval(config, dataset, mesh, index_pool=index_pool)
+
+    monkeypatch.setattr(trainer_mod, "_build_eval_loader", recording_eval)
     cfg = TrainConfig(
         dataset_path=image_dataset.uri, num_classes=10, model_name="resnet18",
         image_size=32, batch_size=16, epochs=1, no_wandb=True, augment=False,
@@ -193,8 +203,10 @@ def test_val_fraction_composes_with_filter(image_dataset, monkeypatch):
         val_fraction=0.25,
     )
     train(cfg)
-    assert len(pools) >= 2
-    train_pool, val_pool = pools[0], pools[-1]
+    train_pools = [p for tag, p in pools if tag == "train"]
+    val_pools = [p for tag, p in pools if tag == "val"]
+    assert train_pools and val_pools
+    train_pool, val_pool = train_pools[0], val_pools[-1]
     assert not set(train_pool) & set(val_pool)
     ds = trainer_mod.Dataset(image_dataset.uri)
     for p in (train_pool, val_pool):
