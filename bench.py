@@ -80,20 +80,19 @@ def make_synthetic_food101(uri: str, rows: int, image_size: int = 224) -> None:
 
 
 def _run(jax, devices) -> dict:
-    # Persistent compile cache: the ResNet-50 train step is a multi-minute
-    # first compile on the tunneled TPU; cache it across bench runs. TPU-only:
-    # XLA:CPU's persistent cache stores AOT machine code whose load is unsound
-    # for collective programs (see tests/conftest.py) and unsound across
-    # machines, so never enable it on the CPU backend.
-    if devices[0].platform != "cpu":
-        cache_dir = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
-        )
-        try:
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        except Exception:
-            pass
+    # Persistent compile cache across bench runs (repo-local dir so every
+    # bench reuses the same warm cache). Guard logic lives in the trainer
+    # helper — accelerator-only; XLA:CPU's cache is unsound (conftest.py).
+    from lance_distributed_training_tpu.trainer import (
+        TrainConfig as _TC,
+        maybe_enable_compile_cache,
+    )
+
+    maybe_enable_compile_cache(
+        devices[0].platform,
+        _TC(dataset_path="", compile_cache_dir=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache")),
+    )
 
     from lance_distributed_training_tpu.data import (
         ImageClassificationDecoder,

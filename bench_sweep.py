@@ -55,15 +55,17 @@ def _time_steps(fn, fetch, n):
 def _run(jax, devices) -> dict:
     import jax.numpy as jnp
 
-    if devices[0].platform != "cpu":
-        cache_dir = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
-        )
-        try:
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        except Exception:
-            pass
+    # Same repo-local warm cache as bench.py; guard logic in the trainer.
+    from lance_distributed_training_tpu.trainer import (
+        TrainConfig as _TC,
+        maybe_enable_compile_cache,
+    )
+
+    maybe_enable_compile_cache(
+        devices[0].platform,
+        _TC(dataset_path="", compile_cache_dir=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache")),
+    )
 
     from lance_distributed_training_tpu.models import get_task
     from lance_distributed_training_tpu.parallel import (

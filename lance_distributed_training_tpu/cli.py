@@ -73,6 +73,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no_wandb", action="store_true")
     p.add_argument("--model_name", type=str, default=None,
                    help="default per task: resnet50 / bert_base / clip_resnet50_bert")
+    p.add_argument("--no_compile_cache", action="store_true",
+                   help="disable the persistent XLA compile cache "
+                        "(accelerator backends only; CPU never caches)")
+    p.add_argument("--compile_cache_dir", type=str, default=None,
+                   help="persistent compile-cache location "
+                        "(default ~/.cache/lance_distributed_training_tpu/jax)")
     p.add_argument("--pretrained", type=str, default=None,
                    help="path to a torch.save'd torchvision ResNet "
                         "state_dict: fine-tune from its backbone weights "
@@ -237,6 +243,8 @@ def main(argv=None) -> dict:
         no_wandb=args.no_wandb,
         model_name=args.model_name,
         pretrained=args.pretrained,
+        compile_cache=not args.no_compile_cache,
+        compile_cache_dir=args.compile_cache_dir,
         image_size=args.image_size,
         seq_len=args.seq_len,
         vocab_size=args.vocab_size,

@@ -77,3 +77,57 @@ def test_metric_logger_jsonl_fallback(tmp_path, monkeypatch):
     logger.finish()
     rec = json.loads(path.read_text().strip())
     assert rec["loss"] == 1.5 and rec["step"] == 0
+
+
+class TestCompileCache:
+    """maybe_enable_compile_cache: accelerator-only, config-gated."""
+
+    def test_never_on_cpu(self):
+        from lance_distributed_training_tpu.trainer import (
+            TrainConfig,
+            maybe_enable_compile_cache,
+        )
+
+        cfg = TrainConfig(dataset_path="")
+        assert maybe_enable_compile_cache("cpu", cfg) is None
+
+    def test_disabled_by_flag(self):
+        from lance_distributed_training_tpu.trainer import (
+            TrainConfig,
+            maybe_enable_compile_cache,
+        )
+
+        cfg = TrainConfig(dataset_path="", compile_cache=False)
+        assert maybe_enable_compile_cache("tpu", cfg) is None
+
+    def test_applies_dir_on_accelerator(self, monkeypatch, tmp_path):
+        import lance_distributed_training_tpu.trainer as tm
+        from lance_distributed_training_tpu.trainer import (
+            TrainConfig,
+            maybe_enable_compile_cache,
+        )
+
+        calls = {}
+        monkeypatch.setattr(
+            tm.jax.config, "update", lambda k, v: calls.__setitem__(k, v)
+        )
+        cache_dir = str(tmp_path / "cache")
+        cfg = TrainConfig(dataset_path="", compile_cache_dir=cache_dir)
+        assert maybe_enable_compile_cache("tpu", cfg) == cache_dir
+        assert calls["jax_compilation_cache_dir"] == cache_dir
+        assert calls["jax_persistent_cache_min_compile_time_secs"] == 1.0
+
+    def test_expands_user_dir(self, monkeypatch):
+        import os
+
+        import lance_distributed_training_tpu.trainer as tm
+        from lance_distributed_training_tpu.trainer import (
+            TrainConfig,
+            maybe_enable_compile_cache,
+        )
+
+        monkeypatch.setattr(tm.jax.config, "update", lambda k, v: None)
+        cfg = TrainConfig(dataset_path="", compile_cache_dir="~/cc")
+        assert maybe_enable_compile_cache("tpu", cfg) == os.path.expanduser(
+            "~/cc"
+        )
