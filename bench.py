@@ -83,15 +83,11 @@ def _run(jax, devices) -> dict:
     # Persistent compile cache across bench runs (repo-local dir so every
     # bench reuses the same warm cache). Guard logic lives in the trainer
     # helper — accelerator-only; XLA:CPU's cache is unsound (conftest.py).
-    from lance_distributed_training_tpu.trainer import (
-        TrainConfig as _TC,
-        maybe_enable_compile_cache,
-    )
+    from lance_distributed_training_tpu.trainer import maybe_enable_compile_cache
 
     maybe_enable_compile_cache(
         devices[0].platform,
-        _TC(dataset_path="", compile_cache_dir=os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), ".jax_cache")),
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
     )
 
     from lance_distributed_training_tpu.data import (

@@ -56,15 +56,11 @@ def _run(jax, devices) -> dict:
     import jax.numpy as jnp
 
     # Same repo-local warm cache as bench.py; guard logic in the trainer.
-    from lance_distributed_training_tpu.trainer import (
-        TrainConfig as _TC,
-        maybe_enable_compile_cache,
-    )
+    from lance_distributed_training_tpu.trainer import maybe_enable_compile_cache
 
     maybe_enable_compile_cache(
         devices[0].platform,
-        _TC(dataset_path="", compile_cache_dir=os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), ".jax_cache")),
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
     )
 
     from lance_distributed_training_tpu.models import get_task

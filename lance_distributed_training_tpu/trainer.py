@@ -753,7 +753,8 @@ def _device_cache_budget_bytes(config: TrainConfig) -> float:
     return budget
 
 
-def maybe_enable_compile_cache(platform: str, config: TrainConfig):
+def maybe_enable_compile_cache(platform: str, cache_dir: Optional[str] = None,
+                               *, enabled: bool = True):
     """Persistent XLA compile cache for accelerator backends.
 
     A cold ResNet-50 train-step compile is minutes on a remote/tunneled TPU;
@@ -762,10 +763,10 @@ def maybe_enable_compile_cache(platform: str, config: TrainConfig):
     is unsound for shard_map collective programs and across hosts (see
     tests/conftest.py). Returns the cache dir applied, or None.
     """
-    if not config.compile_cache or platform == "cpu":
+    if not enabled or platform == "cpu":
         return None
     cache_dir = os.path.expanduser(
-        config.compile_cache_dir
+        cache_dir
         or os.path.join("~", ".cache", "lance_distributed_training_tpu",
                         "jax")
     )
@@ -804,7 +805,8 @@ def train(config: TrainConfig) -> dict:
     devices = jax.devices()
     if config.no_ddp:
         devices = devices[:1]
-    maybe_enable_compile_cache(devices[0].platform, config)
+    maybe_enable_compile_cache(devices[0].platform, config.compile_cache_dir,
+                               enabled=config.compile_cache)
     mesh = get_mesh(
         devices,
         model_parallelism=config.model_parallelism,

@@ -88,8 +88,7 @@ class TestCompileCache:
             maybe_enable_compile_cache,
         )
 
-        cfg = TrainConfig(dataset_path="")
-        assert maybe_enable_compile_cache("cpu", cfg) is None
+        assert maybe_enable_compile_cache("cpu") is None
 
     def test_disabled_by_flag(self):
         from lance_distributed_training_tpu.trainer import (
@@ -97,8 +96,7 @@ class TestCompileCache:
             maybe_enable_compile_cache,
         )
 
-        cfg = TrainConfig(dataset_path="", compile_cache=False)
-        assert maybe_enable_compile_cache("tpu", cfg) is None
+        assert maybe_enable_compile_cache("tpu", enabled=False) is None
 
     def test_applies_dir_on_accelerator(self, monkeypatch, tmp_path):
         import lance_distributed_training_tpu.trainer as tm
@@ -112,8 +110,7 @@ class TestCompileCache:
             tm.jax.config, "update", lambda k, v: calls.__setitem__(k, v)
         )
         cache_dir = str(tmp_path / "cache")
-        cfg = TrainConfig(dataset_path="", compile_cache_dir=cache_dir)
-        assert maybe_enable_compile_cache("tpu", cfg) == cache_dir
+        assert maybe_enable_compile_cache("tpu", cache_dir) == cache_dir
         assert calls["jax_compilation_cache_dir"] == cache_dir
         assert calls["jax_persistent_cache_min_compile_time_secs"] == 1.0
 
@@ -127,7 +124,6 @@ class TestCompileCache:
         )
 
         monkeypatch.setattr(tm.jax.config, "update", lambda k, v: None)
-        cfg = TrainConfig(dataset_path="", compile_cache_dir="~/cc")
-        assert maybe_enable_compile_cache("tpu", cfg) == os.path.expanduser(
+        assert maybe_enable_compile_cache("tpu", "~/cc") == os.path.expanduser(
             "~/cc"
         )
