@@ -27,13 +27,16 @@ def parse(log_path: str) -> dict:
         m = re.search(r"WARNING:(\S+ \S+?),\d+:jax", line)
         if m and current is not None and "started_at" not in current:
             current["started_at"] = m.group(1)
-        if "HUNG" in line and current is not None:
-            current["outcome"] = "hang_>900s"
+        m = re.search(r"HUNG \(> ?(\d+)", line)
+        if m and current is not None:
+            current["outcome"] = f"hang_>{m.group(1)}s"
         m = re.search(r"backend init FAILED: (.+)", line)
         if m and current is not None:
             current["outcome"] = f"error: {m.group(1)[:200]}"
         if re.search(r"devices: \[", line) and current is not None:
             current["outcome"] = "claimed"
+    if attempts and "outcome" not in attempts[-1]:
+        attempts[-1]["outcome"] = "in_progress_at_log_end"
     return {
         "metric": "bench_claim_attempts",
         "attempts": attempts,

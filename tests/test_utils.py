@@ -84,7 +84,6 @@ class TestCompileCache:
 
     def test_never_on_cpu(self):
         from lance_distributed_training_tpu.trainer import (
-            TrainConfig,
             maybe_enable_compile_cache,
         )
 
@@ -92,7 +91,6 @@ class TestCompileCache:
 
     def test_disabled_by_flag(self):
         from lance_distributed_training_tpu.trainer import (
-            TrainConfig,
             maybe_enable_compile_cache,
         )
 
@@ -101,7 +99,6 @@ class TestCompileCache:
     def test_applies_dir_on_accelerator(self, monkeypatch, tmp_path):
         import lance_distributed_training_tpu.trainer as tm
         from lance_distributed_training_tpu.trainer import (
-            TrainConfig,
             maybe_enable_compile_cache,
         )
 
@@ -119,7 +116,6 @@ class TestCompileCache:
 
         import lance_distributed_training_tpu.trainer as tm
         from lance_distributed_training_tpu.trainer import (
-            TrainConfig,
             maybe_enable_compile_cache,
         )
 
