@@ -181,9 +181,13 @@ def test_train_entrypoint_with_model_parallelism(tmp_path):
         seq_len=SEQ,
         vocab_size=VOCAB,
         no_wandb=True,
-        eval_at_end=False,
+        # eval_at_end drives the full-coverage weighted eval (rank-1 _weight
+        # sharded P('data') beside a P('data','seq') token batch) on the
+        # same 2x2x2 mesh — the sharding composition a DP-only test misses.
+        eval_at_end=True,
         model_parallelism=2,
         seq_parallelism=2,
     )
     results = train(cfg)
     assert np.isfinite(results["loss"])
+    assert 0.0 <= results["train_acc"] <= 1.0
