@@ -1,11 +1,16 @@
-"""Convert a bench stderr log into a BENCH_ATTEMPTS_r{N}.json evidence file.
+"""Convert bench stderr log(s) into a BENCH_ATTEMPTS_r{N}.json evidence file.
 
 Round 3 established the pattern: when the tunneled chip is unclaimable for
 the whole bench window, the committed evidence is the structured attempt
 history (timestamps, per-attempt outcome) so the judge can verify the
 outage rather than take it on faith.
 
-Usage: python collect_bench_attempts.py bench_r04_err.txt BENCH_ATTEMPTS_r04.json
+Multiple logs merge into one record (retry batches): each attempt carries a
+``batch`` index (1-based position of its log on the command line) so
+attempt numbers stay unambiguous across batches, and the output's ``logs``
+field is a machine-readable list of the parsed paths.
+
+Usage: python collect_bench_attempts.py LOG [LOG ...] OUT.json
 """
 
 import json
@@ -13,15 +18,14 @@ import re
 import sys
 
 
-def parse(log_path: str) -> dict:
+def parse_log(log_path: str, batch: int) -> list[dict]:
     attempts = []
     current = None
     for line in open(log_path, errors="replace"):
-        m = re.search(
-            r"backend init attempt (\d+)/(\d+)", line
-        )
+        m = re.search(r"backend init attempt (\d+)/(\d+)", line)
         if m:
-            current = {"attempt": int(m.group(1)),
+            current = {"batch": batch,
+                       "attempt": int(m.group(1)),
                        "max_attempts": int(m.group(2))}
             attempts.append(current)
         m = re.search(r"WARNING:(\S+ \S+?),\d+:jax", line)
@@ -37,18 +41,25 @@ def parse(log_path: str) -> dict:
             current["outcome"] = "claimed"
     if attempts and "outcome" not in attempts[-1]:
         attempts[-1]["outcome"] = "in_progress_at_log_end"
+    return attempts
+
+
+def parse(log_paths: list[str]) -> dict:
+    attempts = []
+    for batch, path in enumerate(log_paths, start=1):
+        attempts.extend(parse_log(path, batch))
     return {
         "metric": "bench_claim_attempts",
         "attempts": attempts,
         "n_attempts": len(attempts),
         "n_claimed": sum(1 for a in attempts if a.get("outcome") == "claimed"),
-        "log": log_path,
+        "logs": log_paths,
     }
 
 
 if __name__ == "__main__":
-    out = parse(sys.argv[1])
-    with open(sys.argv[2], "w") as f:
+    out = parse(sys.argv[1:-1])
+    with open(sys.argv[-1], "w") as f:
         json.dump(out, f, indent=1)
     print(f"{out['n_attempts']} attempts, {out['n_claimed']} claimed "
-          f"-> {sys.argv[2]}")
+          f"-> {sys.argv[-1]}")

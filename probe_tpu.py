@@ -1,0 +1,98 @@
+"""Minimal TPU liveness probe: claim, then one tiny execution, value-fetched.
+
+Distinguishes the two outage signatures seen in rounds 3-4:
+  * claim-hang   — ``jax.devices()`` blocks (>900 s); r3 + r4 batch 1/2.
+  * execute-hang — claim returns instantly but the first compile/execute
+    RPC never completes (r4, 03:48 UTC: bench.py claimed in 0.2 s then
+    blocked >10 min with zero client CPU inside ``create_train_state``).
+
+Prints ONE JSON line; exits 0 only when a real value came back from the
+chip. The hang watchdog is a daemon ``threading.Timer`` + ``os._exit``
+(the ``_HangWatchdog`` pattern from ``_bench_init.py``), NOT ``signal.alarm``:
+a claim-hang blocks inside a C/gRPC call where the main thread never
+returns to the interpreter, so a Python signal handler would never run —
+only another thread can still emit the structured line and exit.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+TIMEOUT_S = int(os.environ.get("PROBE_TIMEOUT", "240") or 240)
+_t0 = time.time()
+_stage = "import"
+
+
+def _fire() -> None:
+    print(json.dumps({
+        "probe": "tpu_liveness",
+        "ok": False,
+        "stage": _stage,
+        "elapsed_s": round(time.time() - _t0, 1),
+        "error": f"hang: stage '{_stage}' exceeded {TIMEOUT_S}s",
+    }), flush=True)
+    os._exit(2)
+
+
+def main() -> int:
+    global _stage
+    watchdog = threading.Timer(TIMEOUT_S, _fire)
+    watchdog.daemon = True
+    watchdog.start()
+
+    import jax
+
+    # Re-pin the backend choice: the axon sitecustomize force-updates
+    # jax_platforms to "axon,cpu" at interpreter start (see _bench_init.py),
+    # and the ",cpu" fallback would let a fast-failing dead chip masquerade
+    # as healthy by answering the probe matmul on host CPU.
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms is not None:
+        try:
+            jax.config.update("jax_platforms", env_platforms or None)
+        except Exception:  # noqa: BLE001 — platform check below still guards
+            pass
+
+    _stage = "claim"
+    t_claim = time.time()
+    devices = jax.devices()
+    claim_s = time.time() - t_claim
+
+    expect = os.environ.get("PROBE_EXPECT_PLATFORM", "tpu")
+    if devices[0].platform != expect:
+        watchdog.cancel()
+        print(json.dumps({
+            "probe": "tpu_liveness",
+            "ok": False,
+            "stage": "platform",
+            "error": f"claimed platform {devices[0].platform!r}, "
+                     f"expected {expect!r} (quiet backend fallback)",
+            "devices": [str(d) for d in devices],
+        }), flush=True)
+        return 3
+
+    _stage = "execute"
+    import jax.numpy as jnp
+
+    t_exec = time.time()
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    y = float(jnp.sum(x @ x))  # value fetch = true completion barrier
+    exec_s = time.time() - t_exec
+
+    watchdog.cancel()
+    print(json.dumps({
+        "probe": "tpu_liveness",
+        "ok": True,
+        "claim_s": round(claim_s, 2),
+        "first_execute_s": round(exec_s, 2),
+        "value": y,
+        "devices": [str(d) for d in devices],
+        "platform": devices[0].platform,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
