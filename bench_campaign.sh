@@ -97,8 +97,21 @@ run_stage() { # $1 name, $2 artifact, $3 expected lines, $4 timeout_s, rest: com
   run_grouped "$tmo" "$artifact.tmp" env BENCH_INIT_TIMEOUT=300 "$@"
   local rc=$?
   # Keep only the JSON record lines (stdout is JSON-only by contract;
-  # belt-and-braces against stray prints).
-  grep '^{' "$artifact.tmp" > "$artifact" 2>/dev/null; rm -f "$artifact.tmp"
+  # belt-and-braces against stray prints) — and never let a WORSE retry
+  # clobber a better partial artifact from an earlier attempt (the
+  # ABANDONED path keeps the best partial, so a zero-line hang retry must
+  # not truncate a 4/6-config one).
+  grep '^{' "$artifact.tmp" > "$artifact.new" 2>/dev/null; rm -f "$artifact.tmp"
+  # grep -c prints 0 (and exits 1) on no-match, prints nothing on a missing
+  # file — so default the empty case rather than `|| echo`.
+  local new_n=$(grep -c '^{' "$artifact.new" 2>/dev/null); new_n=${new_n:-0}
+  local old_n=$(grep -c '^{' "$artifact" 2>/dev/null); old_n=${old_n:-0}
+  if [ "$new_n" -ge "$old_n" ]; then
+    mv "$artifact.new" "$artifact"
+  else
+    note "stage $name: retry produced $new_n lines < existing $old_n — keeping existing artifact"
+    rm -f "$artifact.new"
+  fi
   # Artifact completeness decides success — a teardown crash after the
   # final record prints (rc!=0) must not discard a finished measurement.
   if stage_done "$artifact" "$nlines"; then
@@ -124,8 +137,10 @@ protocol() {
 }
 
 note "=== campaign start (max $MAX_PROBES probes, gap ${PROBE_GAP}s) ==="
+gap=$PROBE_GAP
 for i in $(seq 1 "$MAX_PROBES"); do
-  if PROBE_TIMEOUT=240 timeout 300 python probe_tpu.py >> "$LOG" 2>> "$ERR"; then
+  if PROBE_TIMEOUT=240 timeout 300 python probe_tpu.py > .probe_last.json 2>> "$ERR"; then
+    cat .probe_last.json >> "$LOG"
     note "probe $i/$MAX_PROBES: chip healthy — running protocol"
     if protocol; then
       if [ "$ABANDONED" -eq 1 ]; then
@@ -135,10 +150,22 @@ for i in $(seq 1 "$MAX_PROBES"); do
       note "=== ALL FOUR ARTIFACTS COMPLETE ==="
       exit 0
     fi
+    gap=$PROBE_GAP
   else
-    note "probe $i/$MAX_PROBES: chip not healthy"
+    cat .probe_last.json >> "$LOG" 2>/dev/null
+    # A probe killed mid-claim can itself refresh the stale-grant condition
+    # (_bench_init.py's documented killed-mid-claim hazard), so consecutive
+    # claim-hangs back the gap off toward the grant TTL instead of
+    # re-poisoning every 9 minutes; any other outcome resets the cadence.
+    if grep -q '"stage": "claim"' .probe_last.json 2>/dev/null; then
+      gap=$(( gap * 2 )); [ "$gap" -gt 1800 ] && gap=1800
+      note "probe $i/$MAX_PROBES: claim-hang — backing off to ${gap}s"
+    else
+      gap=$PROBE_GAP
+      note "probe $i/$MAX_PROBES: chip not healthy"
+    fi
   fi
-  sleep "$PROBE_GAP"
+  sleep "$gap"
 done
 note "=== campaign exhausted $MAX_PROBES probes without completing protocol ==="
 exit 1

@@ -52,6 +52,23 @@ def _time_steps(fn, fetch, n):
     return time.perf_counter() - t0
 
 
+def _time_train_steps(step, state, batch, rng, n):
+    """Time n donated train steps, rebinding state each iteration (the
+    bench.py device-only pattern): donation invalidates the argument
+    buffers, so the loop must thread the returned state through — and in
+    exchange XLA updates params/optimizer state in place instead of
+    copying ~300 MB of Adam state every step. Closes with a loss value
+    fetch (the only true completion barrier on this backend). Returns
+    (wall_seconds, final_state)."""
+    state, loss = step(state, batch, rng)
+    float(loss)  # sync entry (and absorb any remaining compile)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, loss = step(state, batch, rng)
+    float(loss)
+    return time.perf_counter() - t0, state
+
+
 def _run(jax, devices) -> dict:
     import jax.numpy as jnp
 
@@ -97,10 +114,11 @@ def _run(jax, devices) -> dict:
             image_size=image_size, augment=False, param_dtype=param_dtype,
         )
         cfg = TrainConfig(dataset_path="", num_classes=101)
-        state = jax.device_put(
-            create_train_state(jax.random.key(0), task, cfg), repl
-        )
-        step = make_train_step(task, mesh, donate=False)
+        # Donated step, same as training and bench.py's device-only pass:
+        # without donation the optimizer update round-trips ~300 MB of
+        # params + Adam moments through fresh HBM allocations every step,
+        # and the sweep would understate the rate it exists to measure.
+        step = make_train_step(task, mesh)
         for per_chip_batch in batches:
             global_batch = per_chip_batch * n_chips
             batch = make_global_batch(
@@ -112,14 +130,12 @@ def _run(jax, devices) -> dict:
                 },
                 mesh,
             )
+            # Fresh state per point: donation consumes the previous one.
+            state = jax.device_put(
+                create_train_state(jax.random.key(0), task, cfg), repl
+            )
             try:
-                state2, loss = step(state, batch, rng)  # compile
-                float(loss)
-                wall = _time_steps(
-                    lambda: step(state, batch, rng),
-                    lambda: float(step(state, batch, rng)[1]),
-                    steps,
-                )
+                wall, state = _time_train_steps(step, state, batch, rng, steps)
             except Exception as e:  # noqa: BLE001 — OOM at big batches is data
                 log(f"{param_dtype_name} b{per_chip_batch}: FAILED {e}")
                 grid.append({
@@ -128,9 +144,7 @@ def _run(jax, devices) -> dict:
                     "error": str(e)[:300],
                 })
                 continue
-            # steps+1 fetch-closed steps ran in wall (the fetch lambda runs
-            # one extra step); count them honestly.
-            ran = steps + 1
+            ran = steps
             step_ms = wall / ran * 1e3
             img_s_chip = ran * global_batch / wall / n_chips
             mfu = img_s_chip * TRAIN_FLOPS_PER_IMAGE / (peak_tflops * 1e12) * 100
@@ -241,7 +255,7 @@ def _run(jax, devices) -> dict:
         "train_flops_per_image": TRAIN_FLOPS_PER_IMAGE,
         "chips": n_chips,
         "platform": devices[0].platform,
-        "measured_steps_per_point": steps + 1,
+        "measured_steps_per_point": steps,
         **mem,
     }
     if trace_dir:

@@ -58,6 +58,11 @@ def parse(log_paths: list[str]) -> dict:
 
 
 if __name__ == "__main__":
+    # Guard the variadic argv: with a forgotten OUT.json the last log file
+    # would silently become the write target and be destroyed.
+    if len(sys.argv) < 3 or not sys.argv[-1].endswith(".json"):
+        sys.exit(f"usage: {sys.argv[0]} LOG [LOG ...] OUT.json "
+                 "(output must end in .json)")
     out = parse(sys.argv[1:-1])
     with open(sys.argv[-1], "w") as f:
         json.dump(out, f, indent=1)
