@@ -1,14 +1,15 @@
 #!/bin/bash
-# Round-4 perf-evidence campaign: probe the tunneled chip cheaply, and the
+# Round-5 perf-evidence campaign: probe the tunneled chip cheaply, and the
 # moment a probe confirms BOTH claim and execute are healthy, run the full
-# four-artifact protocol from PERF_NOTES_r04.md in order:
+# four-artifact protocol (PERF_NOTES_r04.md, carried into r5) in order:
 #
 #   1. bench.py            (headline: streaming + device-only + cached + MFU)
 #   2. bench_sweep.py      (batch x param-dtype MFU grid + step breakdown)
 #   3. bench_suite.py DC=1 (five TPU train() configs, device-cache steady state)
 #   4. bench_suite.py DC=0 (same five configs, pure streaming path)
-#      (the sixth config, food101-resnet18-map, is CPU-by-definition and
-#      already committed as BENCH_SUITE_r04_cpu_map.json — see protocol())
+#      (the CPU-by-definition configs — food101-resnet18-map and the folder
+#      control arms — don't need the chip window; they are benchmarked
+#      host-side by bench_ab.py into BENCH_AB_r05.json)
 #
 # Each stage checkpoints to its artifact file; a stage whose artifact already
 # holds its full expected record set (every line parses, no null values,
@@ -17,7 +18,9 @@
 # group-killed (setsid + kill of the whole process group — bench_suite runs
 # each config in a child process, and an orphaned child would keep the chip
 # grant alive forever). A stage that keeps failing is abandoned after
-# MAX_STAGE_ATTEMPTS so one bad config can't eat the whole window.
+# MAX_STAGE_ATTEMPTS for THIS healthy window (one bad config can't eat the
+# window) and gets a fresh budget at the next one — the campaign only exits
+# when all four artifacts are complete, or on operator signal.
 #
 # Probe-first matters on this tunnel: the r4 outage showed TWO distinct
 # failure signatures (claim-hang: jax.devices() blocks >900s; execute-hang:
@@ -30,24 +33,104 @@
 # outer group-kill is the backstop, not the primary timeout (_bench_init.py
 # warns that an external SIGTERM mid-claim can leave a stale grant).
 #
-# Usage: bash bench_campaign.sh [max_probe_attempts]   (default 60)
+# The probe loop is UNBOUNDED by default (r4 lesson: a 60-probe budget ~= 30h
+# ran out silently while the outage continued). The log is rotated in place
+# so an arbitrarily long campaign can't fill the disk, and any exit — success,
+# abandonment, or crash — drops a loud CAMPAIGN_EXIT marker file stating the
+# outcome so the next session trips over it instead of reading log tails.
+#
+# Usage: bash bench_campaign.sh [max_probe_attempts]   (default 0 = unbounded)
 
 cd "$(dirname "$0")" || exit 1
-LOG=bench_campaign_r04.log
-# NOT bench_r04_err.txt: that file is the committed batch-1 outage evidence
-# (cited by BENCH_ATTEMPTS_r04.json, parsed by collect_bench_attempts.py) —
-# campaign attempts get their own log so the record stays uncontaminated.
-ERR=bench_campaign_r04_err.txt
-MAX_PROBES=${1:-60}
+LOG=bench_campaign_r05.log
+ERR=bench_campaign_r05_err.txt
+MAX_PROBES=${1:-0}           # 0 = probe forever until the protocol lands
+case "$MAX_PROBES" in
+  ''|*[!0-9]*) echo "bench_campaign.sh: max_probe_attempts must be a non-negative integer, got '$MAX_PROBES'" >&2; exit 2 ;;
+esac
 PROBE_GAP=${PROBE_GAP:-540}
 MAX_STAGE_ATTEMPTS=${MAX_STAGE_ATTEMPTS:-3}
 ABANDONED=0
 
 # Attempt counters are per-campaign-launch: a relaunch after an outage gets
 # a fresh budget (completed stages are still skipped via stage_done).
-rm -f .stage_attempts_*
+rm -f .stage_attempts_* CAMPAIGN_EXIT
 
 note() { echo "[campaign $(date -u '+%F %T')] $*" >> "$LOG"; }
+
+# Loud exit marker: whatever ends this process, the next session finds one
+# file at the repo root saying what happened, not a silent dead watcher.
+# Also reap the active stage's process group — a signal mid-stage must not
+# orphan a setsid'd bench child that would hold the chip grant forever
+# (the exact hazard the group-kill in run_grouped exists for).
+STAGE_PG=""
+finish() {
+  local why=${1:-"crashed or killed (trap)"}
+  # Unconditional group-kill: checking only the leader pid would skip the
+  # sweep when the leader died but a grandchild (bench_suite's per-config
+  # child) survived holding the chip grant.
+  if [ -n "$STAGE_PG" ]; then
+    note "killing active stage pg $STAGE_PG on exit"
+    kill -TERM -- "-$STAGE_PG" 2>/dev/null
+    sleep 5
+    kill -KILL -- "-$STAGE_PG" 2>/dev/null
+  fi
+  { echo "campaign exited: $why"
+    echo "at: $(date -u '+%F %T') UTC"
+    echo "log: $LOG"; } > CAMPAIGN_EXIT
+  note "=== EXIT: $why ==="
+}
+# TERM/INT/HUP don't run bash's EXIT trap on their own — and `kill <pid>` is
+# the most likely way this long-lived watcher dies; trap them explicitly so
+# the marker is written, then re-raise for the correct exit status.
+trap 'finish' EXIT
+# The plain `exit` after the re-raise is a belt-and-braces fallback: a lost
+# self-signal (observed once on this box) must not leave a zombie watcher.
+trap 'finish "killed by SIGTERM"; trap - EXIT TERM; kill -TERM $$; exit 143' TERM
+trap 'finish "killed by SIGINT"; trap - EXIT INT; kill -INT $$; exit 130' INT
+trap 'finish "killed by SIGHUP"; trap - EXIT HUP; kill -HUP $$; exit 129' HUP
+
+die() { # $1 reason, $2 exit code — every deliberate exit goes through here
+  finish "$1"
+  trap - EXIT TERM INT HUP
+  exit "$2"
+}
+
+rotate_log() { # keep the campaign runnable for weeks without filling disk
+  for f in "$LOG" "$ERR"; do
+    if [ -f "$f" ] && [ "$(wc -c < "$f")" -gt 1048576 ]; then
+      # Bound by BYTES, not lines: XLA/HLO error dumps can put >1MB on a
+      # single line, which a line-count rotation would never shrink.
+      tail -c 524288 "$f" > "$f.tmp" && mv "$f.tmp" "$f"
+      note "rotated $f (kept last 512KB)"
+    fi
+  done
+}
+
+# Count lines that parse as JSON with a non-null "value" — the SAME criterion
+# stage_done uses. Raw '^{' counts are wrong here: bench_suite emits
+# {"metric":...,"error":...,"value":null} lines per failed config, so a retry
+# where the chip dies mid-stage can print 5 error lines and must not beat a
+# partial artifact holding 4 real measurements.
+valid_records() { # $1 file
+  python - "$1" <<'EOF'
+import json, sys
+n = 0
+try:
+    for l in open(sys.argv[1]):
+        l = l.strip()
+        if not l:
+            continue
+        try:
+            if json.loads(l).get("value") is not None:
+                n += 1
+        except Exception:
+            pass
+except Exception:
+    pass
+print(n)
+EOF
+}
 
 stage_done() { # $1 artifact, $2 expected line count: every line must parse
   python - "$1" "$2" <<'EOF'
@@ -67,6 +150,7 @@ run_grouped() { # $1 timeout_s, $2 stdout_file, rest: command — group-kill on 
   local tmo=$1 out=$2; shift 2
   setsid "$@" > "$out" 2>> "$ERR" &
   local pid=$! t=0
+  STAGE_PG=$pid
   while kill -0 "$pid" 2>/dev/null; do
     if [ "$t" -ge "$tmo" ]; then
       note "  group-killing stage pg $pid after ${tmo}s"
@@ -74,11 +158,18 @@ run_grouped() { # $1 timeout_s, $2 stdout_file, rest: command — group-kill on 
       sleep 20
       kill -KILL -- "-$pid" 2>/dev/null
       wait "$pid" 2>/dev/null
+      STAGE_PG=""
       return 124
     fi
     sleep 10; t=$((t + 10))
   done
   wait "$pid"
+  local rc=$?
+  # Sweep the group even on normal leader exit: a leader OOM-killed (or
+  # crashed) mid-config can leave a grandchild alive in the group.
+  kill -TERM -- "-$pid" 2>/dev/null
+  STAGE_PG=""
+  return $rc
 }
 
 run_stage() { # $1 name, $2 artifact, $3 expected lines, $4 timeout_s, rest: command
@@ -100,18 +191,22 @@ run_stage() { # $1 name, $2 artifact, $3 expected lines, $4 timeout_s, rest: com
   local rc=$?
   # Keep only the JSON record lines (stdout is JSON-only by contract;
   # belt-and-braces against stray prints) — and never let a WORSE retry
-  # clobber a better partial artifact from an earlier attempt (the
-  # ABANDONED path keeps the best partial, so a zero-line hang retry must
-  # not truncate a 4/6-config one).
+  # clobber a better partial artifact from an earlier attempt. "Better" is
+  # measured in VALID records (non-null value), not raw JSON lines: error
+  # records are JSON too and must not count as progress.
   grep '^{' "$artifact.tmp" > "$artifact.new" 2>/dev/null; rm -f "$artifact.tmp"
-  # grep -c prints 0 (and exits 1) on no-match, prints nothing on a missing
-  # file — so default the empty case rather than `|| echo`.
-  local new_n=$(grep -c '^{' "$artifact.new" 2>/dev/null); new_n=${new_n:-0}
-  local old_n=$(grep -c '^{' "$artifact" 2>/dev/null); old_n=${old_n:-0}
-  if [ "$new_n" -ge "$old_n" ]; then
+  local new_n=$(valid_records "$artifact.new")
+  local old_n=$(valid_records "$artifact")
+  # Tie-break equal valid counts on raw JSON lines: an error-record-only
+  # artifact (0 valid, 5 error lines naming the failed configs) is still
+  # diagnostic evidence and must not be replaced by a zero-output hang retry
+  # (0 valid, 0 lines).
+  local new_raw=$(grep -c '^{' "$artifact.new" 2>/dev/null); new_raw=${new_raw:-0}
+  local old_raw=$(grep -c '^{' "$artifact" 2>/dev/null); old_raw=${old_raw:-0}
+  if [ "$new_n" -gt "$old_n" ] || { [ "$new_n" -eq "$old_n" ] && [ "$new_raw" -ge "$old_raw" ]; }; then
     mv "$artifact.new" "$artifact"
   else
-    note "stage $name: retry produced $new_n lines < existing $old_n — keeping existing artifact"
+    note "stage $name: retry produced $new_n valid/$new_raw raw records vs existing $old_n/$old_raw — keeping existing artifact"
     rm -f "$artifact.new"
   fi
   # Artifact completeness decides success — a teardown crash after the
@@ -120,42 +215,56 @@ run_stage() { # $1 name, $2 artifact, $3 expected lines, $4 timeout_s, rest: com
     note "stage $name: SUCCESS -> $artifact"
     return 0
   fi
-  note "stage $name: FAILED (rc=$rc, artifact incomplete) — back to probing"
+  note "stage $name: FAILED (rc=$rc, artifact incomplete, $new_n valid records) — back to probing"
   return 1
 }
 
 protocol() {
-  run_stage headline BENCH_r04_headline.json 1 2400 \
+  run_stage headline BENCH_r05_headline.json 1 2400 \
     env BENCH_STEPS=100 BENCH_MAX_ATTEMPTS=2 python bench.py || return 1
-  run_stage sweep BENCH_SWEEP_r04.json 1 3600 \
+  run_stage sweep BENCH_SWEEP_r05.json 1 3600 \
     env BENCH_SWEEP_STEPS=30 BENCH_MAX_ATTEMPTS=2 python bench_sweep.py || return 1
-  # The five TPU configs only: food101-resnet18-map is single-process CPU by
-  # definition and already committed this round (BENCH_SUITE_r04_cpu_map.json);
-  # re-running it at 100 steps costs ~27 min of 1-core CPU per suite stage —
-  # time better spent keeping the chip window short.
+  # The five TPU configs only: the CPU-by-definition configs are benchmarked
+  # host-side (bench_ab.py) and don't need the chip window.
   local tpu_configs="food101-resnet50-iter imagenet-fragment c4-bert laion-clip gpt-causal"
-  run_stage suite_cached BENCH_SUITE_r04_cached.json 5 4800 \
+  run_stage suite_cached BENCH_SUITE_r05_cached.json 5 4800 \
     env BENCH_DEVICE_CACHE=1 BENCH_SUITE_STEPS=100 BENCH_MAX_ATTEMPTS=2 \
     python bench_suite.py $tpu_configs || return 1
-  run_stage suite_streaming BENCH_SUITE_r04_streaming.json 5 4800 \
+  run_stage suite_streaming BENCH_SUITE_r05_streaming.json 5 4800 \
     env BENCH_DEVICE_CACHE=0 BENCH_SUITE_STEPS=100 BENCH_MAX_ATTEMPTS=2 \
     python bench_suite.py $tpu_configs || return 1
   return 0
 }
 
-note "=== campaign start (max $MAX_PROBES probes, gap ${PROBE_GAP}s) ==="
+if [ "$MAX_PROBES" -gt 0 ]; then probes_desc="$MAX_PROBES max"; else probes_desc="unbounded"; fi
+note "=== campaign start (probes: $probes_desc, gap ${PROBE_GAP}s) ==="
 gap=$PROBE_GAP
-for i in $(seq 1 "$MAX_PROBES"); do
+i=0
+while :; do
+  i=$((i + 1))
+  if [ "$MAX_PROBES" -gt 0 ] && [ "$i" -gt "$MAX_PROBES" ]; then
+    die "exhausted $MAX_PROBES probes without completing protocol" 1
+  fi
+  rotate_log
+  rm -f .probe_last.json
+  probe_t0=$(date +%s)
   if PROBE_TIMEOUT=240 timeout 300 python probe_tpu.py > .probe_last.json 2>> "$ERR"; then
     cat .probe_last.json >> "$LOG"
-    note "probe $i/$MAX_PROBES: chip healthy — running protocol"
+    crashes=0
+    # Fresh per-WINDOW stage budget: a stage that died 3 times in earlier
+    # windows (chip flaking mid-stage) gets another 3 tries now — the
+    # unbounded campaign keeps hunting for a window good enough to finish,
+    # instead of permanently abandoning after 3 failures total. Completed
+    # stages are still skipped via stage_done.
+    rm -f .stage_attempts_*
+    ABANDONED=0
+    note "probe $i: chip healthy — running protocol"
     if protocol; then
       if [ "$ABANDONED" -eq 1 ]; then
-        note "=== PROTOCOL FINISHED WITH ABANDONED STAGES (partial artifacts) ==="
-        exit 3
+        note "window ended with ABANDONED stages — keeping partial artifacts, back to probing"
+      else
+        die "ALL FOUR ARTIFACTS COMPLETE" 0
       fi
-      note "=== ALL FOUR ARTIFACTS COMPLETE ==="
-      exit 0
     fi
     gap=$PROBE_GAP
   else
@@ -163,16 +272,45 @@ for i in $(seq 1 "$MAX_PROBES"); do
     # A probe killed mid-claim can itself refresh the stale-grant condition
     # (_bench_init.py's documented killed-mid-claim hazard), so consecutive
     # claim-hangs back the gap off toward the grant TTL instead of
-    # re-poisoning every 9 minutes; any other outcome resets the cadence.
-    if grep -q '"stage": "claim"' .probe_last.json 2>/dev/null; then
-      gap=$(( gap * 2 )); [ "$gap" -gt 1800 ] && gap=1800
-      note "probe $i/$MAX_PROBES: claim-hang — backing off to ${gap}s"
-    else
+    # re-poisoning every 9 minutes. An EMPTY or missing probe JSON means the
+    # outer `timeout 300` killed the probe before its watchdog printed —
+    # which in practice is the same claim-path hang — and a probe stuck at
+    # the import stage is claim-adjacent too; both back off rather than
+    # resetting to the fast cadence the backoff exists to avoid.
+    probe_dt=$(( $(date +%s) - probe_t0 ))
+    if { [ ! -s .probe_last.json ] && [ "$probe_dt" -lt 230 ]; } \
+       || { grep -q '"stage": "import"' .probe_last.json 2>/dev/null \
+            && grep -q '"error": "exception' .probe_last.json 2>/dev/null; }; then
+      # Local crash, not an outage: either a hard kill with no output before
+      # the watchdog window (a hang, by construction, runs the full
+      # PROBE_TIMEOUT=240s before anything kills it), or a structured
+      # exception at the IMPORT stage (broken jax install — the probe's
+      # except-handler prints these; a claim-stage exception is a tunnel
+      # error and takes the backoff branch below). Backing off 1800s forever
+      # would misdiagnose a config error as a tunnel outage; instead fail
+      # loudly after a few consecutive crashes.
+      crashes=$(( ${crashes:-0} + 1 ))
+      note "probe $i: CRASHED in ${probe_dt}s (local error, not an outage) — $crashes consecutive"
+      if [ "$crashes" -ge 5 ]; then
+        tail -c 2048 "$ERR" >> "$LOG" 2>/dev/null
+        die "probe crashed $crashes times in a row — local environment error, see $ERR" 4
+      fi
       gap=$PROBE_GAP
-      note "probe $i/$MAX_PROBES: chip not healthy"
+    elif grep -qE '"stage": "(claim|import)"' .probe_last.json 2>/dev/null \
+       || [ ! -s .probe_last.json ]; then
+      crashes=0
+      gap=$(( gap * 2 )); [ "$gap" -gt 1800 ] && gap=1800
+      note "probe $i: claim-hang (or killed pre-watchdog) — backing off to ${gap}s"
+    else
+      crashes=0
+      gap=$PROBE_GAP
+      note "probe $i: chip not healthy"
     fi
   fi
-  sleep "$gap"
+  # Background + wait, not a foreground sleep: bash defers signal traps
+  # while waiting on a foreground child, which would delay the CAMPAIGN_EXIT
+  # marker by up to the full 1800s backoff (and invite a kill -9 that writes
+  # no marker at all).
+  sleep "$gap" &
+  wait $!
 done
-note "=== campaign exhausted $MAX_PROBES probes without completing protocol ==="
-exit 1

@@ -95,4 +95,20 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BaseException as e:  # noqa: BLE001 — structured line no matter what
+        # A fast-RAISING outage (e.g. connection refused from the tunnel)
+        # must still leave a parseable line: the campaign classifies an
+        # empty stdout + fast exit as a LOCAL crash, and a quick
+        # `UNAVAILABLE` from jax.devices() is an outage, not a local error.
+        if isinstance(e, SystemExit):
+            raise
+        print(json.dumps({
+            "probe": "tpu_liveness",
+            "ok": False,
+            "stage": _stage,
+            "elapsed_s": round(time.time() - _t0, 1),
+            "error": f"exception: {type(e).__name__}: {e}",
+        }), flush=True)
+        sys.exit(5)
