@@ -61,6 +61,26 @@ def env_int(name: str, default: int) -> int:
         return default
 
 
+def force_cpu(n_devices: int = 1) -> None:
+    """Pin this process to the host CPU backend, defeating the axon
+    sitecustomize's platform override. Shared by every CPU-by-definition
+    bench (bench_suite config 1, bench_ab, bench_convergence) so the
+    pinning sequence can never diverge between them. Must run BEFORE any
+    backend query."""
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except RuntimeError:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+    # init_devices honors an explicit JAX_PLATFORMS env choice by re-pinning
+    # jax_platforms from it — on a box that exports JAX_PLATFORMS=axon that
+    # would silently undo this CPU pin and send a "CPU by definition" config
+    # to the TPU tunnel. Make the env agree with the pin.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
 def env_float(name: str, default: float) -> float:
     try:
         return float(os.environ.get(name, "") or default)

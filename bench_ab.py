@@ -65,14 +65,9 @@ QUADRANTS = [
 
 
 def _force_cpu() -> None:
-    import jax
+    from _bench_init import force_cpu
 
-    try:
-        jax.config.update("jax_num_cpu_devices", 1)
-    except RuntimeError:
-        pass
-    jax.config.update("jax_platforms", "cpu")
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    force_cpu(1)
 
 
 def _build_corpus(root: str, rows: int, tag: str) -> tuple[str, str]:

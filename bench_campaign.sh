@@ -17,10 +17,14 @@
 # any failure without redoing finished work. A stage that hangs is
 # group-killed (setsid + kill of the whole process group — bench_suite runs
 # each config in a child process, and an orphaned child would keep the chip
-# grant alive forever). A stage that keeps failing is abandoned after
-# MAX_STAGE_ATTEMPTS for THIS healthy window (one bad config can't eat the
-# window) and gets a fresh budget at the next one — the campaign only exits
-# when all four artifacts are complete, or on operator signal.
+# grant alive forever). Stage attempt counters are CUMULATIVE across the
+# whole campaign launch: a stage failure aborts the window (the chip is
+# presumed to have gone bad — later stages would only burn their timeouts),
+# but after MAX_STAGE_ATTEMPTS failures across that many windows the stage
+# is ABANDONED — skipped in later windows so the stages behind it finally
+# get their chance. When every stage is either complete or abandoned the
+# campaign exits 3 with partial artifacts (relaunching grants fresh
+# budgets); it exits 0 only with all four artifacts complete.
 #
 # Probe-first matters on this tunnel: the r4 outage showed TWO distinct
 # failure signatures (claim-hang: jax.devices() blocks >900s; execute-hang:
@@ -49,7 +53,7 @@ case "$MAX_PROBES" in
   ''|*[!0-9]*) echo "bench_campaign.sh: max_probe_attempts must be a non-negative integer, got '$MAX_PROBES'" >&2; exit 2 ;;
 esac
 PROBE_GAP=${PROBE_GAP:-540}
-MAX_STAGE_ATTEMPTS=${MAX_STAGE_ATTEMPTS:-3}
+MAX_STAGE_ATTEMPTS=${MAX_STAGE_ATTEMPTS:-6}
 ABANDONED=0
 
 # Attempt counters are per-campaign-launch: a relaunch after an outage gets
@@ -251,20 +255,18 @@ while :; do
   if PROBE_TIMEOUT=240 timeout 300 python probe_tpu.py > .probe_last.json 2>> "$ERR"; then
     cat .probe_last.json >> "$LOG"
     crashes=0
-    # Fresh per-WINDOW stage budget: a stage that died 3 times in earlier
-    # windows (chip flaking mid-stage) gets another 3 tries now — the
-    # unbounded campaign keeps hunting for a window good enough to finish,
-    # instead of permanently abandoning after 3 failures total. Completed
-    # stages are still skipped via stage_done.
-    rm -f .stage_attempts_*
     ABANDONED=0
     note "probe $i: chip healthy — running protocol"
+    # protocol() returning success means every stage is either complete
+    # (stage_done) or permanently abandoned (cumulative budget exhausted) —
+    # either way there is nothing left for another window to add, so exit
+    # with the honest status. A failed return means the window went bad
+    # mid-protocol: back to probing, remaining budgets intact.
     if protocol; then
       if [ "$ABANDONED" -eq 1 ]; then
-        note "window ended with ABANDONED stages — keeping partial artifacts, back to probing"
-      else
-        die "ALL FOUR ARTIFACTS COMPLETE" 0
+        die "protocol finished WITH ABANDONED STAGES (partial artifacts; relaunch for fresh budgets)" 3
       fi
+      die "ALL FOUR ARTIFACTS COMPLETE" 0
     fi
     gap=$PROBE_GAP
   else
@@ -292,8 +294,12 @@ while :; do
       crashes=$(( ${crashes:-0} + 1 ))
       note "probe $i: CRASHED in ${probe_dt}s (local error, not an outage) — $crashes consecutive"
       if [ "$crashes" -ge 5 ]; then
-        tail -c 2048 "$ERR" >> "$LOG" 2>/dev/null
-        die "probe crashed $crashes times in a row — local environment error, see $ERR" 4
+        # The stderr tail goes into the marker file, NOT the campaign log:
+        # stage stderr contains "backend init attempt N/M" lines that
+        # collect_bench_attempts.py would parse as phantom attempts.
+        { echo "--- last stderr ($ERR):"; tail -c 2048 "$ERR"; } \
+          >> CAMPAIGN_EXIT.detail 2>/dev/null
+        die "probe crashed $crashes times in a row — local environment error, see $ERR and CAMPAIGN_EXIT.detail" 4
       fi
       gap=$PROBE_GAP
     elif grep -qE '"stage": "(claim|import)"' .probe_last.json 2>/dev/null \

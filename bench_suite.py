@@ -57,18 +57,9 @@ CONFIG_NAMES = [
 
 
 def _force_cpu(n_devices: int = 1) -> None:
-    import jax
+    from _bench_init import force_cpu
 
-    try:
-        jax.config.update("jax_num_cpu_devices", n_devices)
-    except RuntimeError:
-        pass
-    jax.config.update("jax_platforms", "cpu")
-    # init_devices honors an explicit JAX_PLATFORMS env choice by re-pinning
-    # jax_platforms from it — on a box that exports JAX_PLATFORMS=axon that
-    # would silently undo this CPU pin and send a "CPU by definition" config
-    # to the TPU tunnel. Make the env agree with the pin.
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    force_cpu(n_devices)
 
 
 def _train_metrics(cfg, steps_hint: int) -> dict:

@@ -10,7 +10,19 @@ Multiple logs merge into one record (retry batches): each attempt carries a
 attempt numbers stay unambiguous across batches, and the output's ``logs``
 field is a machine-readable list of the parsed paths.
 
-Usage: python collect_bench_attempts.py LOG [LOG ...] OUT.json
+Two log dialects are parsed (both applied to every log; they match
+disjoint line shapes, so mixing is harmless):
+
+* bench stderr logs — ``backend init attempt N/M`` blocks from
+  ``_bench_init.py`` (rounds 3-4, ``bench_r0N_err.txt``);
+* campaign logs — ``bench_campaign.sh`` probe records: each probe's JSON
+  line (``{"probe": "tpu_liveness", ...}``) followed by its
+  ``[campaign TS] probe N: outcome`` note. Round 4's hand-authored probe
+  batches existed because this parser couldn't read them; now it can, so
+  regenerating an ATTEMPTS file from the full log list is lossless
+  (pass ``--note`` to carry a root-cause annotation into the output).
+
+Usage: python collect_bench_attempts.py [--note TEXT] LOG [LOG ...] OUT.json
 """
 
 import json
@@ -44,27 +56,81 @@ def parse_log(log_path: str, batch: int) -> list[dict]:
     return attempts
 
 
-def parse(log_paths: list[str]) -> dict:
+def parse_campaign_log(log_path: str, batch: int) -> list[dict]:
+    """bench_campaign.sh probe records: a probe JSON line, then the
+    campaign's ``probe N: outcome`` note (r4 logs say ``probe N/60:``)."""
+    attempts = []
+    last_probe = None
+    for line in open(log_path, errors="replace"):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                j = json.loads(line)
+            except ValueError:
+                continue
+            if j.get("probe"):
+                last_probe = j
+            continue
+        m = re.search(
+            r"\[campaign (\S+ \S+)\] probe (\d+)(?:/\d+)?: (.+)", line)
+        if not m:
+            continue
+        ts, n, msg = m.group(1), int(m.group(2)), m.group(3)
+        a = {"batch": batch, "attempt": n, "kind": "campaign_probe",
+             "noted_at": ts}
+        if "chip healthy" in msg:
+            a["outcome"] = "claimed"
+        elif "claim-hang" in msg:
+            a["outcome"] = "hang_claim"
+        elif "CRASHED" in msg:
+            a["outcome"] = "local_crash"
+        else:
+            a["outcome"] = msg[:120]
+        if last_probe is not None:
+            a["stage"] = last_probe.get("stage")
+            if last_probe.get("elapsed_s") is not None:
+                a["elapsed_s"] = last_probe["elapsed_s"]
+            if last_probe.get("error"):
+                a["error"] = str(last_probe["error"])[:200]
+            last_probe = None
+        attempts.append(a)
+    return attempts
+
+
+def parse(log_paths: list[str], note: str | None = None) -> dict:
     attempts = []
     for batch, path in enumerate(log_paths, start=1):
         attempts.extend(parse_log(path, batch))
-    return {
+        attempts.extend(parse_campaign_log(path, batch))
+    out = {
         "metric": "bench_claim_attempts",
         "attempts": attempts,
         "n_attempts": len(attempts),
         "n_claimed": sum(1 for a in attempts if a.get("outcome") == "claimed"),
         "logs": log_paths,
     }
+    if note:
+        out["note"] = note
+    return out
 
 
 if __name__ == "__main__":
+    argv = sys.argv[1:]
+    note = None
+    if "--note" in argv:
+        i = argv.index("--note")
+        if i + 1 >= len(argv):
+            sys.exit(f"usage: {sys.argv[0]} [--note TEXT] LOG [LOG ...] "
+                     "OUT.json (--note needs a value)")
+        note = argv[i + 1]
+        del argv[i : i + 2]
     # Guard the variadic argv: with a forgotten OUT.json the last log file
     # would silently become the write target and be destroyed.
-    if len(sys.argv) < 3 or not sys.argv[-1].endswith(".json"):
-        sys.exit(f"usage: {sys.argv[0]} LOG [LOG ...] OUT.json "
+    if len(argv) < 2 or not argv[-1].endswith(".json"):
+        sys.exit(f"usage: {sys.argv[0]} [--note TEXT] LOG [LOG ...] OUT.json "
                  "(output must end in .json)")
-    out = parse(sys.argv[1:-1])
-    with open(sys.argv[-1], "w") as f:
+    out = parse(argv[:-1], note=note)
+    with open(argv[-1], "w") as f:
         json.dump(out, f, indent=1)
     print(f"{out['n_attempts']} attempts, {out['n_claimed']} claimed "
-          f"-> {sys.argv[-1]}")
+          f"-> {argv[-1]}")
