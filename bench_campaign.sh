@@ -58,7 +58,7 @@ ABANDONED=0
 
 # Attempt counters are per-campaign-launch: a relaunch after an outage gets
 # a fresh budget (completed stages are still skipped via stage_done).
-rm -f .stage_attempts_* CAMPAIGN_EXIT
+rm -f .stage_attempts_* CAMPAIGN_EXIT CAMPAIGN_EXIT.detail
 
 note() { echo "[campaign $(date -u '+%F %T')] $*" >> "$LOG"; }
 

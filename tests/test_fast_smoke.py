@@ -1,0 +1,51 @@
+"""Fast-tier train-step smoke: the one jitted step that gates every commit.
+
+All trainer-loop/sharding/optimizer coverage lives in the slow tier
+(``pytest -m slow``, ~45 min on a small host), so before this test the
+per-commit gate (``pytest -m fast``, seconds) never exercised
+``make_train_step`` at all — a step-breaking regression would only surface
+per-round. This runs ONE real mesh-sharded jitted train step at the
+smallest shapes that still cover the production path (8-device dp mesh,
+NamedSharding global batch, grad psum, SGD update), budgeted to stay well
+under the fast tier's per-commit latency envelope.
+"""
+
+import jax
+import numpy as np
+
+from lance_distributed_training_tpu.models import get_task
+from lance_distributed_training_tpu.parallel import get_mesh, make_global_batch
+from lance_distributed_training_tpu.trainer import (
+    TrainConfig,
+    create_sharded_train_state,
+    make_train_step,
+)
+
+# NOT marked slow — conftest auto-marks it fast.
+
+
+def test_jitted_train_step_smoke():
+    task = get_task("classification", model_name="resnet18", num_classes=10,
+                    image_size=32, augment=False)
+    mesh = get_mesh()
+    cfg = TrainConfig(dataset_path="", lr=0.1, momentum=0.9)
+    state, sharding = create_sharded_train_state(
+        jax.random.key(0), task, cfg, mesh, ()
+    )
+    step = make_train_step(task, mesh, state_sharding=sharding, donate=False)
+    gen = np.random.default_rng(0)
+    batch = make_global_batch(
+        {
+            "image": gen.integers(0, 255, (16, 32, 32, 3)).astype(np.uint8),
+            "label": gen.integers(0, 10, (16,)).astype(np.int32),
+        },
+        mesh,
+    )
+    losses = []
+    for i in range(2):
+        state, loss = step(state, batch, jax.random.key(i + 1))
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    # Two SGD steps on the same batch must reduce its loss — catches a step
+    # that runs but silently stops learning (zero grads, detached update).
+    assert losses[1] < losses[0]
