@@ -117,6 +117,58 @@ def emit_error(metric: str, stage: str, error: str, attempts: int,
     sys.exit(1)
 
 
+def preflight_execute(metric: str, timeout_s: float | None = None) -> None:
+    """One tiny compiled matmul, value-fetched, under a hang watchdog.
+
+    The r4 outage's second signature is an EXECUTE-hang: ``jax.devices()``
+    returns instantly but the first compile RPC blocks forever with zero
+    client CPU (server-side ``remote_compile`` refused). A bench script
+    without this check hangs in its first real compile until some outer
+    timeout kills it — leaving NO structured record (r4's ``BENCH_r04.json``
+    was rc=124/parsed=null for exactly this reason). With it, the script
+    leaves a parseable error line and exits in ~4 min instead.
+
+    Thread-timer + ``os._exit``, not ``signal.alarm``: the hang is inside a
+    C/gRPC call, so only another thread can still run (probe_tpu.py's
+    watchdog pattern). ``emit_error`` can't be used from the timer thread —
+    its ``sys.exit`` would only kill the timer thread — so the record is
+    printed directly.
+    """
+    import threading
+
+    import jax.numpy as jnp
+
+    t = (timeout_s if timeout_s is not None
+         else env_float("BENCH_PREFLIGHT_TIMEOUT", 240.0))
+
+    def _fire() -> None:
+        print(json.dumps(_error_record(
+            metric, "preflight_execute",
+            f"hang: first compile/execute exceeded {t:.0f}s "
+            "(execute-hang outage signature — claim OK, remote compile dead)",
+            init_attempts(),
+        )), flush=True)
+        os._exit(2)
+
+    timer = threading.Timer(t, _fire)
+    timer.daemon = True
+    timer.start()
+    log("preflight: compiling one tiny matmul (execute-hang guard)")
+    try:
+        x = jnp.ones((128, 128), jnp.float32)
+        val = float(jnp.sum(x @ x))  # value fetch = true completion barrier
+    except Exception as e:  # noqa: BLE001 — a RAISING first compile (fast
+        # connection-refused instead of a hang) must also leave the one
+        # structured line the stdout contract promises.
+        timer.cancel()
+        log(f"preflight FAILED: {type(e).__name__}: {e}")
+        emit_error(metric, "preflight_execute",
+                   f"{type(e).__name__}: {e}", init_attempts())
+        return  # unreachable (emit_error exits); keeps control flow obvious
+    timer.cancel()
+    log(f"preflight ok (sum={val:.0f})")
+
+
 class _HangWatchdog:
     """Treat a ``jax.devices()`` call exceeding ``timeout_s`` as a transient
     failure: a killed-mid-claim predecessor can leave the tunnel grant stale,

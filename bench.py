@@ -46,7 +46,14 @@ import time
 
 import numpy as np
 
-from _bench_init import emit_error, env_int, init_attempts, init_devices, log
+from _bench_init import (
+    emit_error,
+    env_int,
+    init_attempts,
+    init_devices,
+    log,
+    preflight_execute,
+)
 
 METRIC = "food101_resnet50_images_per_sec_per_chip"
 
@@ -318,6 +325,7 @@ def _run(jax, devices) -> dict:
 
 def main() -> None:
     jax, devices = init_devices(METRIC)
+    preflight_execute(METRIC)
     attempts = init_attempts()
     try:
         result = _run(jax, devices)

@@ -86,7 +86,7 @@ def _train_metrics(cfg, steps_hint: int) -> dict:
 
 
 def run_config(name: str) -> dict:
-    from _bench_init import init_devices
+    from _bench_init import init_devices, preflight_execute
 
     from lance_distributed_training_tpu.trainer import TrainConfig
 
@@ -100,8 +100,11 @@ def run_config(name: str) -> dict:
         _force_cpu(1)
 
     # Shared robust claim: retries transient UNAVAILABLE with backoff via
-    # re-exec, fails fast (structured JSON, rc=1) on permanent errors.
+    # re-exec, fails fast (structured JSON, rc=1) on permanent errors. The
+    # preflight guards the r4 execute-hang signature (claim OK, first
+    # compile RPC dead) with a structured error instead of a silent hang.
     _jax, devices = init_devices(metric=name)
+    preflight_execute(name)
 
     tmp = tempfile.mkdtemp(prefix=f"ldt-suite-{name}-")
     uri = os.path.join(tmp, "ds")

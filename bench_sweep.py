@@ -33,7 +33,14 @@ import time
 
 import numpy as np
 
-from _bench_init import emit_error, env_int, init_attempts, init_devices, log
+from _bench_init import (
+    emit_error,
+    env_int,
+    init_attempts,
+    init_devices,
+    log,
+    preflight_execute,
+)
 
 METRIC = "resnet50_device_only_mfu_sweep"
 
@@ -265,6 +272,7 @@ def _run(jax, devices) -> dict:
 
 def main() -> None:
     jax, devices = init_devices(METRIC)
+    preflight_execute(METRIC)
     attempts = init_attempts()
     try:
         result = _run(jax, devices)
