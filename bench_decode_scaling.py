@@ -137,9 +137,12 @@ def main() -> None:
             cover = cores
 
     # os.cpu_count() may return None (some containers); treat unknown as 1 —
-    # the conservative label. Rows beyond the host's core count are always
-    # extrapolation, so a multi-core host validates only up to itself.
+    # the conservative label. Measurement backs the projection only up to
+    # BOTH the host's core count AND the largest swept producer count: a
+    # 16-core host still only measured producers 1/2/4, so rows beyond
+    # min(host_cores, max_swept) stay labeled extrapolation.
     host_cores = os.cpu_count() or 1
+    validated_cores = min(host_cores, max(s["producers"] for s in sweep))
 
     result = {
         "metric": METRIC,
@@ -160,8 +163,8 @@ def main() -> None:
         # count are backed by measurement (the serial-read floor is measured
         # either way).
         "projection_status": (
-            "conjecture_until_multicore_validation" if host_cores == 1
-            else f"validated_up_to_{host_cores}_cores_rest_extrapolated"
+            "conjecture_until_multicore_validation" if validated_cores == 1
+            else f"validated_up_to_{validated_cores}_cores_rest_extrapolated"
         ),
         "device_rate_to_cover_img_s": device_rate,
         "min_cores_covering_device_rate": cover,
@@ -170,9 +173,9 @@ def main() -> None:
             "scaling; the projection is the committed model — validate on "
             "multi-core hardware. Serial floor conservatively counts the "
             "whole Arrow read as GIL-serial."
-            if host_cores == 1 else
+            if validated_cores == 1 else
             f"producer sweep is a real scaling measurement up to "
-            f"{host_cores} cores; projection rows beyond that remain "
+            f"{validated_cores} cores; projection rows beyond that remain "
             "extrapolation"
         ),
     }

@@ -1,0 +1,125 @@
+"""Evidence-tooling invariants: attempt-log parsing and the probe contract.
+
+The outage-evidence chain (probe_tpu.py JSON lines → bench_campaign.sh
+classification → collect_bench_attempts.py ATTEMPTS files) is what the
+per-round perf record rests on when the chip is unreachable; a silent
+format drift between those three would corrupt the record without any
+test noticing. These are pure-host tests (no jax import).
+"""
+
+import json
+import subprocess
+import sys
+import os
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from collect_bench_attempts import parse, parse_campaign_log, parse_log
+
+
+BENCH_LOG = """\
+[bench +    0.1s] backend init attempt 1/5 (jax 0.9.0, JAX_PLATFORMS=<unset>)
+WARNING:2026-07-30 23:11:02,152:jax._src.xla_bridge:905: Platform 'axon' is experimental
+[bench +  901.0s] backend init HUNG (> 900s) — re-exec (attempt 2)
+[bench +    0.1s] backend init attempt 2/5 (jax 0.9.0, JAX_PLATFORMS=<unset>)
+[bench +   10.2s] backend init FAILED: RuntimeError: UNAVAILABLE: connection refused
+[bench +    0.1s] backend init attempt 3/5 (jax 0.9.0, JAX_PLATFORMS=<unset>)
+[bench +    2.0s] devices: [TpuDevice(id=0)]
+"""
+
+CAMPAIGN_LOG_R5 = """\
+[campaign 2026-07-31 17:52:03] === campaign start (probes: unbounded, gap 540s) ===
+{"probe": "tpu_liveness", "ok": false, "stage": "claim", "elapsed_s": 240.0, "error": "hang: stage 'claim' exceeded 240s"}
+[campaign 2026-07-31 17:56:05] probe 1: claim-hang (or killed pre-watchdog) — backing off to 1080s
+{"probe": "tpu_liveness", "ok": true, "claim_s": 0.21, "first_execute_s": 1.4, "value": 2097152.0, "devices": ["TpuDevice(id=0)"], "platform": "tpu"}
+[campaign 2026-07-31 18:30:00] probe 2: chip healthy — running protocol
+[campaign 2026-07-31 19:00:00] probe 3: CRASHED in 2s (local error, not an outage) — 1 consecutive
+"""
+
+CAMPAIGN_LOG_R4_DIALECT = """\
+{"probe": "tpu_liveness", "ok": false, "stage": "claim", "elapsed_s": 240.0, "error": "hang: stage 'claim' exceeded 240s"}
+[campaign 2026-07-31 08:52:08] probe 3/60: claim-hang — backing off to 1800s
+"""
+
+
+def test_parse_bench_stderr_dialect(tmp_path):
+    p = tmp_path / "bench_err.txt"
+    p.write_text(BENCH_LOG)
+    attempts = parse_log(str(p), batch=1)
+    assert [a["attempt"] for a in attempts] == [1, 2, 3]
+    assert attempts[0]["outcome"] == "hang_>900s"
+    assert attempts[1]["outcome"].startswith("error: RuntimeError")
+    assert attempts[2]["outcome"] == "claimed"
+
+
+def test_parse_campaign_dialect_r5(tmp_path):
+    p = tmp_path / "campaign.log"
+    p.write_text(CAMPAIGN_LOG_R5)
+    attempts = parse_campaign_log(str(p), batch=2)
+    assert [a["attempt"] for a in attempts] == [1, 2, 3]
+    assert attempts[0]["outcome"] == "hang_claim"
+    assert attempts[0]["stage"] == "claim"
+    assert attempts[0]["elapsed_s"] == 240.0
+    assert attempts[1]["outcome"] == "claimed"
+    assert attempts[2]["outcome"] == "local_crash"
+    assert all(a["batch"] == 2 and a["kind"] == "campaign_probe"
+               for a in attempts)
+
+
+def test_parse_campaign_dialect_r4_probe_counts(tmp_path):
+    # r4 logs wrote "probe N/60:"; the parser must read both forms.
+    p = tmp_path / "campaign_r4.log"
+    p.write_text(CAMPAIGN_LOG_R4_DIALECT)
+    (a,) = parse_campaign_log(str(p), batch=1)
+    assert a["attempt"] == 3
+    assert a["outcome"] == "hang_claim"
+
+
+def test_parse_merges_dialects_and_counts_claims(tmp_path):
+    b = tmp_path / "bench.txt"
+    b.write_text(BENCH_LOG)
+    c = tmp_path / "campaign.log"
+    c.write_text(CAMPAIGN_LOG_R5)
+    out = parse([str(b), str(c)], note="root cause: remote_compile down")
+    assert out["n_attempts"] == 6
+    assert out["n_claimed"] == 2  # one per dialect
+    assert out["note"] == "root cause: remote_compile down"
+    assert out["logs"] == [str(b), str(c)]
+
+
+def test_note_flag_missing_value_fails_before_clobbering(tmp_path):
+    log = tmp_path / "x.log"
+    log.write_text(CAMPAIGN_LOG_R4_DIALECT)
+    out = tmp_path / "out.json"
+    r = subprocess.run(
+        [sys.executable, "collect_bench_attempts.py", str(log), str(out),
+         "--note"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode != 0
+    assert "usage" in (r.stderr + r.stdout)
+    assert not out.exists()
+    assert log.read_text() == CAMPAIGN_LOG_R4_DIALECT  # log untouched
+
+
+def test_probe_contract_stages_match_campaign_classifier():
+    """bench_campaign.sh classifies outages by grepping the probe's JSON for
+    stage names; if probe_tpu.py renames a stage the classifier silently
+    stops backing off. Pin the contract from both sides' sources."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    probe_src = open(os.path.join(root, "probe_tpu.py")).read()
+    campaign_src = open(os.path.join(root, "bench_campaign.sh")).read()
+    # Stages the probe can emit.
+    for stage in ("import", "claim", "platform", "execute"):
+        assert f'"{stage}"' in probe_src
+    # The classifier greps for exactly the claim-adjacent ones, with the
+    # json.dumps spacing the probe uses.
+    assert '"stage": "(claim|import)"' in campaign_src
+    assert '"stage": "import"' in campaign_src
+    # The probe's watchdog/exception lines both use json.dumps default
+    # spacing — ": " — which the greps above rely on.
+    fake = json.dumps({"stage": "claim"})
+    assert '"stage": "claim"' in fake
