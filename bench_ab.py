@@ -73,36 +73,16 @@ def _force_cpu() -> None:
 def _build_corpus(root: str, rows: int, tag: str) -> tuple[str, str]:
     """Folder tree of ``rows`` JPEGs (64-image unique pool, FOOD101-shaped
     class layout) + a byte-identical columnar import of that tree."""
-    import io
-
-    import numpy as np
-    from PIL import Image
-
     from lance_distributed_training_tpu.data.authoring import (
         create_dataset_from_image_folder,
+        create_synthetic_image_folder,
     )
 
-    tree = os.path.join(root, f"{tag}-folder")
+    tree = create_synthetic_image_folder(
+        os.path.join(root, f"{tag}-folder"), rows,
+        num_classes=NUM_CLASSES, image_size=IMAGE_SIZE,
+    )
     uri = os.path.join(root, f"{tag}-columnar")
-    rng = np.random.default_rng(0)
-    pool = []
-    for _ in range(min(64, rows)):
-        arr = (rng.random((IMAGE_SIZE, IMAGE_SIZE, 3)) * 255).astype(np.uint8)
-        buf = io.BytesIO()
-        Image.fromarray(arr).save(buf, format="JPEG", quality=85)
-        pool.append(buf.getvalue())
-    per_class = max(rows // NUM_CLASSES, 1)
-    n = 0
-    for c in range(NUM_CLASSES):
-        cdir = os.path.join(tree, f"class_{c:03d}")
-        os.makedirs(cdir, exist_ok=True)
-        take = per_class if c < NUM_CLASSES - 1 else rows - n
-        for i in range(take):
-            with open(os.path.join(cdir, f"{i:05d}.jpg"), "wb") as f:
-                f.write(pool[(n + i) % len(pool)])
-        n += take
-        if n >= rows:
-            break
     create_dataset_from_image_folder(
         tree, uri, fragment_size=max(rows // 4, 1), batch_size=512,
     )

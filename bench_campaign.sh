@@ -5,11 +5,12 @@
 #
 #   1. bench.py            (headline: streaming + device-only + cached + MFU)
 #   2. bench_sweep.py      (batch x param-dtype MFU grid + step breakdown)
-#   3. bench_suite.py DC=1 (five TPU train() configs, device-cache steady state)
-#   4. bench_suite.py DC=0 (same five configs, pure streaming path)
-#      (the CPU-by-definition configs — food101-resnet18-map and the folder
-#      control arms — don't need the chip window; they are benchmarked
-#      host-side by bench_ab.py into BENCH_AB_r05.json)
+#   3. bench_suite.py DC=1 (six TPU train() configs incl. the folder
+#                           control arm, device-cache steady state)
+#   4. bench_suite.py DC=0 (same six configs, pure streaming path —
+#                           the on-chip columnar-vs-files comparison)
+#      (the CPU-by-definition map config is benchmarked host-side by
+#      bench_ab.py into BENCH_AB_r05.json and needs no chip window)
 #
 # Each stage checkpoints to its artifact file; a stage whose artifact already
 # holds its full expected record set (every line parses, no null values,
@@ -228,13 +229,15 @@ protocol() {
     env BENCH_STEPS=100 BENCH_MAX_ATTEMPTS=2 python bench.py || return 1
   run_stage sweep BENCH_SWEEP_r05.json 1 3600 \
     env BENCH_SWEEP_STEPS=30 BENCH_MAX_ATTEMPTS=2 python bench_sweep.py || return 1
-  # The five TPU configs only: the CPU-by-definition configs are benchmarked
-  # host-side (bench_ab.py) and don't need the chip window.
-  local tpu_configs="food101-resnet50-iter imagenet-fragment c4-bert laion-clip gpt-causal"
-  run_stage suite_cached BENCH_SUITE_r05_cached.json 5 4800 \
+  # The six TPU configs (incl. the folder control arm — its line next to
+  # food101-resnet50-iter's is the reference's columnar-vs-files comparison
+  # on chip); the CPU-by-definition map config is benchmarked host-side
+  # (bench_ab.py) and doesn't need the chip window.
+  local tpu_configs="food101-resnet50-iter food101-folder-iter imagenet-fragment c4-bert laion-clip gpt-causal"
+  run_stage suite_cached BENCH_SUITE_r05_cached.json 6 5400 \
     env BENCH_DEVICE_CACHE=1 BENCH_SUITE_STEPS=100 BENCH_MAX_ATTEMPTS=2 \
     python bench_suite.py $tpu_configs || return 1
-  run_stage suite_streaming BENCH_SUITE_r05_streaming.json 5 4800 \
+  run_stage suite_streaming BENCH_SUITE_r05_streaming.json 6 5400 \
     env BENCH_DEVICE_CACHE=0 BENCH_SUITE_STEPS=100 BENCH_MAX_ATTEMPTS=2 \
     python bench_suite.py $tpu_configs || return 1
   return 0

@@ -1,4 +1,4 @@
-"""Benchmark suite — one entry per BASELINE.json config, plus one extra.
+"""Benchmark suite — one entry per BASELINE.json config, plus two extras.
 
 The driver's headline metric stays in ``bench.py`` (FOOD101 ResNet-50
 iterable, images/sec/chip). This suite covers all five BASELINE configs end
@@ -10,17 +10,21 @@ loop, so the numbers include everything a user would hit:
                               (parity: lance_map_style.py on CPU)
 2. ``food101-resnet50-iter``  FOOD101-shaped, iterable + sharded-batch plan
                               on the available accelerator (bench.py's twin)
-3. ``imagenet-fragment``      ImageNet-shaped (1000 classes), fragment-
+3. ``food101-folder-iter``    beyond-baseline: the torchvision-twin FILE
+                              control arm at identical shapes to config 2 —
+                              the two lines side-by-side are the
+                              columnar-vs-files comparison on chip
+4. ``imagenet-fragment``      ImageNet-shaped (1000 classes), fragment-
                               sharded scan (ShardedFragmentSampler parity)
-4. ``c4-bert``                packed token columns → masked-LM BERT
-5. ``laion-clip``             mixed-modal image+caption → CLIP contrastive
-6. ``gpt-causal``             beyond-baseline: the same packed token columns
+5. ``c4-bert``                packed token columns → masked-LM BERT
+6. ``laion-clip``             mixed-modal image+caption → CLIP contrastive
+7. ``gpt-causal``             beyond-baseline: the same packed token columns
                               → decoder-only next-token GPT (causal
                               attention + shifted loss)
 
 Usage::
 
-    python bench_suite.py                # all six, one JSON line each
+    python bench_suite.py                # all seven, one JSON line each
     python bench_suite.py c4-bert        # just one
     BENCH_SMALL=1 python bench_suite.py  # tiny shapes (CI / smoke)
 
@@ -48,6 +52,11 @@ REFERENCE_IMAGES_PER_SEC_PER_CHIP = 87.7  # /root/reference/README.md:164-184
 CONFIG_NAMES = [
     "food101-resnet18-map",
     "food101-resnet50-iter",
+    # The torchvision-twin control arm on the SAME accelerator/model/shapes
+    # as food101-resnet50-iter — the reference's columnar-vs-files
+    # comparison (README.md:286-290) measured end-to-end on chip. Host-side
+    # loader-tier A/B lives in bench_ab.py; this config is its on-chip twin.
+    "food101-folder-iter",
     "imagenet-fragment",
     "c4-bert",
     "laion-clip",
@@ -138,15 +147,17 @@ def run_config(name: str) -> dict:
         unit, value = "images/sec/chip", m["images_per_sec_per_chip"]
         vs = None
 
-    elif name in ("food101-resnet50-iter", "imagenet-fragment"):
-        # Shared image-benchmark recipe; the two configs differ in class
-        # count, sampler (sharded-batch vs whole-fragment reads, reference
-        # README.md:127-128), and fragment granularity.
-        from lance_distributed_training_tpu.data import (
-            create_synthetic_classification_dataset,
-        )
-
+    elif name in ("food101-resnet50-iter", "imagenet-fragment",
+                  "food101-folder-iter"):
+        # Shared image-benchmark recipe — ONE shape preamble so the
+        # columnar-vs-folder comparison is identical-shapes by
+        # construction. The configs differ in storage arm (columnar vs
+        # ImageFolder tree — the torch_version/iter_style.py twin,
+        # reference README.md:286-290), class count, sampler (sharded-batch
+        # vs whole-fragment reads, README.md:127-128), and fragment
+        # granularity.
         imagenet = name == "imagenet-fragment"
+        folder = name == "food101-folder-iter"
         accel = devices[0].platform != "cpu"
         model = "resnet50" if accel else "resnet18"
         per_chip = 16 if SMALL else (128 if accel else 32)
@@ -155,18 +166,37 @@ def run_config(name: str) -> dict:
         size = 96 if SMALL else 224
         rows = batch * steps
         num_classes = 1000 if imagenet else 101
-        create_synthetic_classification_dataset(
-            uri, rows, num_classes=num_classes, image_size=size,
-            fragment_size=max(rows // (8 if imagenet else 4), 1),
-        )
+        if folder:
+            from lance_distributed_training_tpu.data import (
+                create_synthetic_image_folder,
+            )
+
+            path = create_synthetic_image_folder(
+                os.path.join(tmp, "folder"), rows,
+                num_classes=num_classes, image_size=size,
+            )
+            arm = dict(data_format="folder")
+        else:
+            from lance_distributed_training_tpu.data import (
+                create_synthetic_classification_dataset,
+            )
+
+            create_synthetic_classification_dataset(
+                uri, rows, num_classes=num_classes, image_size=size,
+                fragment_size=max(rows // (8 if imagenet else 4), 1),
+            )
+            path = uri
+            arm = dict(sampler_type="fragment" if imagenet else "batch")
         cfg = TrainConfig(
-            dataset_path=uri, num_classes=num_classes, model_name=model,
+            dataset_path=path, num_classes=num_classes, model_name=model,
             image_size=size, batch_size=batch,
-            sampler_type="fragment" if imagenet else "batch",
-            loader_style="iterable", **common,
+            loader_style="iterable", **arm, **common,
         )
         m = _train_metrics(cfg, steps)
         unit, value = "images/sec/chip", m["images_per_sec_per_chip"]
+        # Both FOOD101 iterable arms share the reference-rate denominator;
+        # their two artifact lines side-by-side give the columnar-vs-files
+        # ratio on identical hardware and shapes.
         vs = (
             round(value / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3)
             if not imagenet and accel and model == "resnet50"

@@ -27,6 +27,7 @@ from .authoring import (  # noqa: F401
     create_dataset_from_image_folder,
     create_food101_datasets,
     create_synthetic_classification_dataset,
+    create_synthetic_image_folder,
     create_synthetic_image_text_dataset,
     create_text_token_dataset,
     ingest_on_process_zero,

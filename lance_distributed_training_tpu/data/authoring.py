@@ -24,6 +24,7 @@ __all__ = [
     "create_dataset_from_image_folder",
     "create_food101_datasets",
     "create_synthetic_classification_dataset",
+    "create_synthetic_image_folder",
     "create_synthetic_image_text_dataset",
     "create_text_token_dataset",
     "ingest_on_process_zero",
@@ -237,6 +238,62 @@ def create_food101_datasets(
             import shutil
 
             shutil.rmtree(extract_dir, ignore_errors=True)
+
+
+def create_synthetic_image_folder(
+    root: str,
+    rows: int,
+    num_classes: int = 101,
+    image_size: int = 224,
+    unique_images: int = 64,
+    seed: int = 0,
+    jpeg_quality: int = 85,
+) -> str:
+    """Synthetic ImageFolder tree (``root/class_XXX/*.jpg``) — the
+    file-based control-arm twin of
+    :func:`create_synthetic_classification_dataset`, sharing its 64-image
+    JPEG pool recipe so columnar-vs-folder benchmarks read comparable
+    bytes (torch_version/ control arm, reference ``README.md:286-290``).
+
+    Each unique pool image is written to disk once and hardlinked into the
+    remaining slots: at benchmark scale (10k+ rows) this cuts tree-building
+    I/O by the pool-duplication factor with identical read-side behavior —
+    which matters when the tree is built inside a scarce accelerator
+    window. Falls back to a copy where hardlinks aren't supported.
+    """
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    pool = []
+    for _ in range(min(unique_images, max(rows, 1))):
+        arr = (rng.random((image_size, image_size, 3)) * 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=jpeg_quality)
+        pool.append(buf.getvalue())
+    first_path: list = [None] * len(pool)
+    per_class = max(rows // num_classes, 1)
+    done = 0
+    for c in range(num_classes):
+        cdir = os.path.join(root, f"class_{c:03d}")
+        os.makedirs(cdir, exist_ok=True)
+        take = per_class if c < num_classes - 1 else rows - done
+        for i in range(take):
+            idx = (done + i) % len(pool)
+            path = os.path.join(cdir, f"{i:05d}.jpg")
+            if first_path[idx] is None:
+                with open(path, "wb") as f:
+                    f.write(pool[idx])
+                first_path[idx] = path
+            else:
+                try:
+                    os.link(first_path[idx], path)
+                except OSError:
+                    with open(path, "wb") as f:
+                        f.write(pool[idx])
+        done += take
+        if done >= rows:
+            break
+    return root
 
 
 def create_synthetic_classification_dataset(
