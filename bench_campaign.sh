@@ -177,10 +177,39 @@ run_grouped() { # $1 timeout_s, $2 stdout_file, rest: command — group-kill on 
   return $rc
 }
 
+commit_artifact() { # $1 stage name, $2 artifact — path-scoped and idempotent
+  # A hard kill mid-commit (the reboot scenario this exists for) can leave
+  # a stale .git/index.lock that would silently disable every future
+  # auto-commit; clear it when it's old and no git process is alive.
+  local lock=.git/index.lock
+  if [ -f "$lock" ] && ! pgrep -x git >/dev/null 2>&1; then
+    local age=$(( $(date +%s) - $(stat -c %Y "$lock" 2>/dev/null || echo 0) ))
+    if [ "$age" -gt 300 ]; then
+      note "removing stale $lock (${age}s old, no git running)"
+      rm -f "$lock"
+    fi
+  fi
+  # Nothing to do when the artifact is already committed and unchanged.
+  [ -z "$(git status --porcelain -- "$2" 2>/dev/null)" ] && return 0
+  # add then PATH-SCOPED commit: the pathspec keeps unrelated staged files
+  # (another session's in-progress work in this shared repo) out of the
+  # campaign's commit.
+  if git add -- "$2" 2>>"$ERR" \
+     && git commit -m "Campaign: $1 artifact landed ($2)" -- "$2" \
+          >>"$ERR" 2>&1; then
+    note "stage $1: artifact committed"
+  else
+    note "stage $1: git commit failed (non-fatal; driver sweeps at round end)"
+  fi
+}
+
 run_stage() { # $1 name, $2 artifact, $3 expected lines, $4 timeout_s, rest: command
   local name=$1 artifact=$2 nlines=$3 tmo=$4; shift 4
   if stage_done "$artifact" "$nlines"; then
     note "stage $name: already complete ($artifact) — skipping"
+    # A finished artifact whose commit failed last time (index lock, kill
+    # mid-commit) still gets committed on the next pass.
+    commit_artifact "$name" "$artifact"
     return 0
   fi
   local attempts_file=".stage_attempts_$name"
@@ -218,6 +247,10 @@ run_stage() { # $1 name, $2 artifact, $3 expected lines, $4 timeout_s, rest: com
   # final record prints (rc!=0) must not discard a finished measurement.
   if stage_done "$artifact" "$nlines"; then
     note "stage $name: SUCCESS -> $artifact"
+    # Commit the evidence the moment it exists: a healthy window can open
+    # and close while nobody is watching, and an uncommitted artifact on a
+    # box that reboots is an artifact that never happened.
+    commit_artifact "$name" "$artifact"
     return 0
   fi
   note "stage $name: FAILED (rc=$rc, artifact incomplete, $new_n valid records) — back to probing"
