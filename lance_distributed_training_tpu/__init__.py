@@ -24,5 +24,5 @@ Gradient synchronisation is sharding-propagated inside a jitted step function
 
 __version__ = "0.1.0"
 
-from . import data, models, ops, parallel, utils  # noqa: F401
+from . import data, models, ops, parallel, service, utils  # noqa: F401
 from .trainer import TrainConfig, train  # noqa: E402,F401  (the public API)

@@ -5,10 +5,19 @@ Flag-for-flag parity with ``/root/reference/lance_iterable.py:136-146`` (plus
 ``lance_map_style.py:128-148``, and TPU knobs). Topology comes from JAX
 process discovery, not torchrun env vars (``lance_iterable.py:154-156``).
 
+Two subcommands share the ``ldt`` entry point:
+
+* ``ldt train …`` (or bare flags, backward-compatible) — the trainer;
+* ``ldt serve-data …`` — the disaggregated input-data service: decode on
+  CPU hosts, trainers point at it with ``--data_service host:port``.
+
 Usage::
 
     python -m lance_distributed_training_tpu.cli --dataset_path /data/food101 \
         --sampler_type batch --batch_size 512 --epochs 10 --lr 0.05
+
+    ldt serve-data --dataset_path /data/food101 --port 8476 --num_workers 8
+    ldt train --dataset_path /data/food101 --data_service cpu-host:8476
 """
 
 from __future__ import annotations
@@ -68,6 +77,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--grad_accum", type=int, default=1,
                    help=">1: accumulate N micro-batches per optimizer update")
     p.add_argument("--num_workers", type=int, default=0)
+    p.add_argument("--data_service", type=str, default=None, metavar="HOST:PORT",
+                   help="stream decoded batches from a running `ldt "
+                        "serve-data` service instead of decoding locally "
+                        "(disaggregated input plane; iterable columnar path)")
     p.add_argument("--no_ddp", action="store_true",
                    help="single-device debug mode (reference --no_ddp)")
     p.add_argument("--no_wandb", action="store_true")
@@ -164,10 +177,61 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def build_serve_parser() -> argparse.ArgumentParser:
+    """``ldt serve-data`` — run a DataService on this (CPU) host. Plan
+    parameters (sampler/batch/shard/seed/epoch) come from each trainer's
+    handshake; this parser only configures the decode plane."""
+    p = argparse.ArgumentParser(
+        prog="ldt serve-data",
+        description="Serve decoded, plan-ordered training batches over TCP "
+                    "(disaggregated input-data service)",
+    )
+    p.add_argument("--dataset_path", type=str, required=True)
+    p.add_argument("--host", type=str, default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8476,
+                   help="0 = pick an ephemeral port (printed at startup)")
+    p.add_argument("--task_type", type=str, default="classification",
+                   choices=["classification", "masked_lm", "causal_lm",
+                            "contrastive"],
+                   help="selects the decode hook; must match the trainer's")
+    p.add_argument("--image_size", type=int, default=224)
+    p.add_argument("--num_workers", type=int, default=0,
+                   help=">0: decode in N spawned worker processes (size to "
+                        "this host's cores)")
+    p.add_argument("--queue_depth", type=int, default=4,
+                   help="bounded per-client batch queue (backpressure)")
+    p.add_argument("--read_retries", type=int, default=3,
+                   help="dataset-read attempts (exponential backoff) before "
+                        "erroring a client stream")
+    p.add_argument("--log_every_s", type=float, default=30.0,
+                   help="periodic service-stats line; 0 = off")
+    return p
+
+
+def serve_main(argv=None) -> dict:
+    """``serve-data`` subcommand body — blocks until interrupted."""
+    args = build_serve_parser().parse_args(argv)
+    from .service.server import DataService, ServeConfig
+
+    service = DataService(ServeConfig(
+        dataset_path=args.dataset_path,
+        host=args.host,
+        port=args.port,
+        task_type=args.task_type,
+        image_size=args.image_size,
+        num_workers=args.num_workers,
+        queue_depth=args.queue_depth,
+        read_retries=args.read_retries,
+        log_every_s=args.log_every_s,
+    ))
+    service.serve_forever()
+    return service.counters.snapshot()
+
+
 def console_entry() -> int:
-    """Entry point for the ``ldt-train`` console script. ``main`` returns
-    the final metrics dict for programmatic callers; a setuptools script
-    wraps its return in ``sys.exit(...)``, which would turn every
+    """Entry point for the ``ldt`` / ``ldt-train`` console scripts. ``main``
+    returns the final metrics dict for programmatic callers; a setuptools
+    script wraps its return in ``sys.exit(...)``, which would turn every
     successful run into exit status 1 with the dict dumped to stderr —
     so the script target is this wrapper, which discards the dict."""
     main()
@@ -175,6 +239,17 @@ def console_entry() -> int:
 
 
 def main(argv=None) -> dict:
+    if argv is None:
+        import sys
+
+        argv = sys.argv[1:]
+    argv = list(argv)
+    # Subcommand dispatch, backward-compatible: bare flags mean `train`
+    # (every existing invocation keeps working).
+    if argv and argv[0] == "serve-data":
+        return serve_main(argv[1:])
+    if argv and argv[0] == "train":
+        argv = argv[1:]
     args = build_parser().parse_args(argv)
     if args.backend == "cpu":
         import jax
@@ -187,6 +262,19 @@ def main(argv=None) -> dict:
         if args.num_cpu_devices > 0:
             try:
                 jax.config.update("jax_num_cpu_devices", args.num_cpu_devices)
+            except AttributeError:
+                # Older jax has no jax_num_cpu_devices option; the XLA host-
+                # platform flag does the same and is read at first backend
+                # init, so setting the env var here (before any device
+                # query) still takes effect.
+                import os
+
+                flags = os.environ.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" not in flags:
+                    os.environ["XLA_FLAGS"] = (
+                        f"{flags} --xla_force_host_platform_device_count="
+                        f"{args.num_cpu_devices}"
+                    ).strip()
             except RuntimeError as e:
                 raise SystemExit(
                     f"--num_cpu_devices must be set before JAX initializes: {e}"
@@ -239,6 +327,7 @@ def main(argv=None) -> dict:
         grad_accum=args.grad_accum,
         fsdp=args.fsdp,
         num_workers=args.num_workers,
+        data_service_addr=args.data_service,
         no_ddp=args.no_ddp,
         no_wandb=args.no_wandb,
         model_name=args.model_name,

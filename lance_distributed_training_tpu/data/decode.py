@@ -28,7 +28,8 @@ from typing import Optional, Union
 import numpy as np
 import pyarrow as pa
 
-__all__ = ["ImageClassificationDecoder", "decode_tensor_image", "numeric_decoder"]
+__all__ = ["ImageClassificationDecoder", "decode_tensor_image",
+           "numeric_decoder", "decoder_for_task"]
 
 _POOL: Optional[ThreadPoolExecutor] = None
 
@@ -203,6 +204,20 @@ class ImageTextDecoder:
             table.column(self.image_column)
         )
         return out
+
+
+def decoder_for_task(task_type: str, image_size: int = 224):
+    """THE task-type → decode-hook dispatch, shared by the trainer and the
+    data-service server. Keeping it in one place is what upholds the
+    service's bit-identical-batches guarantee: a decoder change that only
+    landed on one side would silently train on different tensors."""
+    if task_type == "classification":
+        return ImageClassificationDecoder(image_size=image_size)
+    if task_type in ("masked_lm", "causal_lm"):
+        return numeric_decoder
+    if task_type == "contrastive":
+        return ImageTextDecoder(image_size=image_size)
+    raise ValueError(f"Invalid task type: {task_type}")
 
 
 def numeric_decoder(batch: Union[pa.RecordBatch, pa.Table]) -> dict[str, np.ndarray]:

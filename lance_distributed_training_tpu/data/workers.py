@@ -28,6 +28,7 @@ When to use which decode parallelism:
 from __future__ import annotations
 
 import multiprocessing as mp
+import weakref
 from collections import deque
 from concurrent.futures import ProcessPoolExecutor
 from typing import Callable, Iterable, Iterator, Optional, Sequence, Tuple
@@ -35,7 +36,12 @@ from typing import Callable, Iterable, Iterator, Optional, Sequence, Tuple
 import numpy as np
 import pyarrow as pa
 
-__all__ = ["WorkerPool", "columnar_spec", "folder_spec"]
+__all__ = ["WorkerPool", "columnar_spec", "folder_spec", "RETRYABLE_READ_ERRORS"]
+
+# The transient-read failure set shared by every retry surface (in-worker
+# retries here, the data-service server's read_item): one definition so the
+# policies cannot drift.
+RETRYABLE_READ_ERRORS = (OSError, pa.ArrowInvalid)
 
 # Per-worker state, set by the pool initializer (module-global because
 # ProcessPoolExecutor task functions must be importable module-level names).
@@ -52,7 +58,8 @@ def folder_spec(samples: Sequence[Tuple[str, int]]) -> Tuple[str, object]:
     return ("folder", list(samples))
 
 
-def _init_worker(reader_spec, decode_fn, columns=None) -> None:
+def _init_worker(reader_spec, decode_fn, columns=None,
+                 read_retries=1, retry_backoff_s=0.05) -> None:
     global _STATE
     kind, payload = reader_spec
     if kind == "columnar":
@@ -63,7 +70,7 @@ def _init_worker(reader_spec, decode_fn, columns=None) -> None:
         reader = payload
     else:
         raise ValueError(f"unknown reader spec kind {kind!r}")
-    _STATE = (kind, reader, decode_fn, columns)
+    _STATE = (kind, reader, decode_fn, columns, read_retries, retry_backoff_s)
 
 
 def _read_item(kind: str, reader, item, columns=None) -> pa.Table:
@@ -92,8 +99,24 @@ def _read_item(kind: str, reader, item, columns=None) -> pa.Table:
 
 def _run_item(item):
     assert _STATE is not None, "worker not initialized"
-    kind, reader, decode_fn, columns = _STATE
-    return decode_fn(_read_item(kind, reader, item, columns))
+    kind, reader, decode_fn, columns, read_retries, backoff_s = _STATE
+    retries = max(1, read_retries)
+    last = None
+    for attempt in range(retries):
+        try:
+            table = _read_item(kind, reader, item, columns)
+            break
+        except RETRYABLE_READ_ERRORS as exc:  # transient storage blip
+            last = exc
+            if attempt + 1 < retries:  # no pointless sleep after the last try
+                import time
+
+                time.sleep(backoff_s * (2**attempt))
+    else:
+        raise RuntimeError(
+            f"worker read failed after {retries} attempts: {last}"
+        ) from last
+    return decode_fn(table)
 
 
 class WorkerPool:
@@ -110,7 +133,12 @@ class WorkerPool:
         decode_fn: Callable,
         num_workers: int,
         columns: Optional[Sequence[str]] = None,
+        read_retries: int = 1,
+        retry_backoff_s: float = 0.05,
     ):
+        """``read_retries > 1`` retries transient in-worker read failures
+        (OSError) with exponential backoff — the data-service server passes
+        its retry policy through so remote streams survive storage blips."""
         if num_workers < 1:
             raise ValueError("WorkerPool needs num_workers >= 1")
         self.num_workers = num_workers
@@ -122,12 +150,36 @@ class WorkerPool:
             mp_context=mp.get_context("spawn"),
             initializer=_init_worker,
             initargs=(reader_spec, decode_fn,
-                      list(columns) if columns is not None else None),
+                      list(columns) if columns is not None else None,
+                      read_retries, retry_backoff_s),
         )
+        # Leak guard: if the owning trainer crashes (or simply drops the
+        # pool without shutdown()), the finalizer still tears the executor
+        # down at GC / interpreter exit, so spawned decode processes never
+        # outlive their parent as orphans. Registered against the executor
+        # object directly — a finalizer closing over `self` would keep the
+        # pool alive forever.
+        self._finalizer = weakref.finalize(
+            self, ProcessPoolExecutor.shutdown, self._pool,
+            wait=True, cancel_futures=True,
+        )
+
+    @property
+    def closed(self) -> bool:
+        return not self._finalizer.alive
 
     def imap(self, items: Iterable, window: int = 0) -> Iterator[dict]:
         """Ordered streaming map: results yielded in submission order, at most
-        ``window`` items in flight (default: 2× workers)."""
+        ``window`` items in flight (default: 2× workers).
+
+        On iterator abandonment (generator ``close()``) or a raised decode
+        error, in-flight futures are cancelled so the pool drains instead of
+        decoding an epoch nobody will consume; the pool itself stays warm for
+        the next epoch (``persistent_workers`` parity) — only
+        :meth:`shutdown` / context-manager exit / GC tears it down.
+        """
+        if self.closed:
+            raise RuntimeError("WorkerPool is shut down")
         window = window or 2 * self.num_workers
         it = iter(items)
         pending: deque = deque()
@@ -145,7 +197,9 @@ class WorkerPool:
     def shutdown(self) -> None:
         # wait=True: join the workers — abandoning spawn children mid-task
         # makes them die noisily ("Fatal Python error") at interpreter exit.
-        self._pool.shutdown(wait=True, cancel_futures=True)
+        # Routed through the finalizer so shutdown is idempotent and the
+        # GC-time teardown never runs twice.
+        self._finalizer()
 
     def __enter__(self) -> "WorkerPool":
         return self

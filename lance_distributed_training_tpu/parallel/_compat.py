@@ -1,0 +1,74 @@
+"""Version-tolerant imports for jax APIs that moved between releases.
+
+The package must import cleanly across the jax versions the fleet actually
+runs (the container pins one version; TPU pods often pin another):
+
+* ``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+  top-level ``jax.shard_map`` (~0.6); importing the new location on an
+  older jax is an ImportError that takes the whole package down (every
+  test module's collection died on it — the exact failure this module
+  exists to prevent).
+* ``lax.pcast`` (replication-cast for shard_map's varying-type checking)
+  does not exist on older jax; there the equivalent is to disable the
+  per-output replication check (``check_rep=False``) and make ``pcast``
+  the identity — the program is unchanged, only the static type
+  annotation differs.
+
+Import from here, never from jax directly, for any symbol listed in
+``__all__``.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+
+__all__ = ["shard_map", "pcast", "axis_size"]
+
+try:  # jax >= 0.6: top-level export
+    from jax import shard_map as _shard_map_new
+
+    shard_map = _shard_map_new
+    _HAS_NEW_SHARD_MAP = True
+except ImportError:  # older jax: experimental namespace
+    from functools import wraps
+
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    _HAS_NEW_SHARD_MAP = False
+
+    @wraps(_shard_map_old)
+    def shard_map(f, *args, **kwargs):
+        # Old shard_map's check_rep rejects programs written for the new
+        # varying-type system (pcast below degrades to identity, so scan
+        # carries would fail the replication check); disable it unless the
+        # caller asked for it explicitly.
+        kwargs.setdefault("check_rep", False)
+        return _shard_map_old(f, *args, **kwargs)
+
+
+if hasattr(lax, "axis_size"):
+    axis_size = lax.axis_size
+else:
+    def axis_size(axis_name):
+        # psum of a Python literal constant-folds to the static axis size at
+        # trace time (the documented jax shortcut), so the result is usable
+        # as a fori_loop bound / permutation length exactly like the new API.
+        return lax.psum(1, axis_name)
+
+
+if hasattr(lax, "pcast"):
+    pcast = lax.pcast
+elif hasattr(lax, "pvary") and _HAS_NEW_SHARD_MAP:
+    # Transitional releases: pvary covers the replicated->varying direction
+    # (the only one this codebase uses).
+    def pcast(x, axis_name, to="varying"):
+        if to != "varying":
+            raise NotImplementedError(
+                "this jax only supports pcast(..., to='varying')"
+            )
+        return lax.pvary(x, axis_name)
+else:
+    # Old jax: no varying-type system; shard_map above runs with
+    # check_rep=False, so the annotation is unnecessary — identity.
+    def pcast(x, axis_name, to="varying"):  # noqa: ARG001 - signature parity
+        return x

@@ -1,0 +1,303 @@
+"""``RemoteLoader`` — the client half of the disaggregated input-data plane.
+
+Drop-in replacement for :class:`~..data.pipeline.DataPipeline` on the TPU
+host: iterating yields the *identical* sequence of batches the in-process
+pipeline would produce for the same (dataset, sampler, batch, shard, seed,
+epoch) — the server builds the same deterministic ``Plan`` — but decode ran
+on the service host, so the trainer's cores stay free for ``device_put``
+dispatch. With ``device_put_fn`` bound to ``make_global_batch(mesh)`` the
+trainer sees the exact same ``jax.Array`` contract as every other loader.
+
+Robustness: a background receiver thread prefetches frames into the same
+bounded-queue discipline ``DataPipeline`` uses; every received step is ACKed,
+and a dropped connection mid-epoch reconnects (retry + exponential backoff)
+with ``start_step = last_acked + 1``, resuming the plan without duplicating
+or skipping a step. Stall time (consumer blocked on an empty queue = the
+wire/decode is the bottleneck) accumulates in :class:`ServiceCounters`, so
+``StepTimer.attach_counters`` keeps loader-stall%% attributable.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+import uuid
+from typing import Callable, Iterator, Optional, Sequence
+
+from ..utils.metrics import ServiceCounters
+from . import protocol as P
+
+__all__ = ["RemoteLoader"]
+
+_SENTINEL = object()
+
+
+class RemoteLoader:
+    """Iterate device-ready batches served by a remote :class:`DataService`.
+
+    Parameters mirror ``make_train_pipeline`` where they overlap; decode
+    parameters live server-side (the service owns the decode plane).
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        batch_size: int,
+        process_index: int,
+        process_count: int,
+        device_put_fn: Optional[Callable[[dict], dict]] = None,
+        *,
+        sampler_type: str = "batch",
+        shuffle: bool = False,
+        seed: int = 0,
+        epoch: int = 0,
+        prefetch: int = 2,
+        columns: Optional[Sequence[str]] = None,
+        connect_retries: int = 5,
+        backoff_s: float = 0.2,
+        timeout_s: float = 120.0,
+        task_type: Optional[str] = None,
+        image_size: Optional[int] = None,
+    ):
+        host, sep, port = addr.rpartition(":")
+        if not sep or not port.isdigit():
+            raise ValueError(
+                f"data service address must be host:port, got {addr!r}"
+            )
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self.batch_size = batch_size
+        self.process_index = process_index
+        self.process_count = process_count
+        self.device_put_fn = device_put_fn
+        self.sampler_type = sampler_type
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = epoch
+        self.prefetch = max(1, prefetch)
+        self.columns = list(columns) if columns is not None else None
+        self.connect_retries = connect_retries
+        self.backoff_s = backoff_s
+        self.timeout_s = timeout_s
+        # Declared decode knobs: the server rejects a mismatch at connect
+        # time (silent wrong-resolution training is the alternative).
+        self.task_type = task_type
+        self.image_size = image_size
+        self.counters = ServiceCounters()
+        self.client_id = uuid.uuid4().hex
+        self._num_steps: Optional[int] = None
+        # Set by the active iteration; test/ops hook: closing it simulates a
+        # connection drop and exercises the resume path.
+        self._conn: Optional[socket.socket] = None
+
+    # -- connection management --------------------------------------------
+
+    def _hello(self, start_step: int, probe: bool = False) -> dict:
+        return P.hello(
+            batch_size=self.batch_size,
+            process_index=self.process_index,
+            process_count=self.process_count,
+            sampler_type=self.sampler_type,
+            shuffle=self.shuffle,
+            seed=self.seed,
+            epoch=self.epoch,
+            start_step=start_step,
+            columns=self.columns,
+            client_id=self.client_id,
+            probe=probe,
+            task_type=self.task_type,
+            image_size=self.image_size,
+        )
+
+    def _connect(self, start_step: int, probe: bool = False,
+                 stop: Optional[threading.Event] = None):
+        """Dial + handshake, with retry/backoff. Returns ``(sock, reply)``.
+
+        ``stop`` (the iteration's shutdown event) aborts between attempts
+        and shortens backoff sleeps, so closing an iterator mid-outage
+        returns promptly instead of draining the full retry schedule."""
+        last: Optional[Exception] = None
+        for attempt in range(max(1, self.connect_retries)):
+            if stop is not None and stop.is_set():
+                raise ConnectionError("loader closed during connect")
+            sock = None
+            try:
+                # Short dial timeout: create_connection cannot be interrupted
+                # by the stop event, so an unreachable host must fail fast
+                # (the retry loop provides persistence, not the dial).
+                sock = socket.create_connection(
+                    (self.host, self.port),
+                    timeout=min(self.timeout_s, 10.0),
+                )
+                sock.settimeout(self.timeout_s)  # handshake recv bound
+                if stop is not None:
+                    # Expose the in-progress socket so a concurrent iterator
+                    # close() can break a handshake recv out of its full
+                    # timeout (a half-dead server that accepts but never
+                    # replies would otherwise pin teardown for timeout_s).
+                    self._conn = sock
+                    if stop.is_set():
+                        raise ConnectionError("loader closed during connect")
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                P.send_msg(sock, P.MSG_HELLO, self._hello(start_step, probe))
+                msg_type, reply = P.recv_msg(sock)
+                if msg_type == P.MSG_ERROR:
+                    # Handshake rejections (version skew, bad shard) are
+                    # permanent — retrying cannot fix them.
+                    raise P.ProtocolError(
+                        f"server rejected handshake: {reply.get('message')}"
+                    )
+                if msg_type != P.MSG_HELLO_OK:
+                    raise P.ProtocolError(
+                        f"expected HELLO_OK, got message type {msg_type}"
+                    )
+                self._num_steps = int(reply["num_steps"])
+                # Streaming phase: no recv deadline. A slow step (cold
+                # decode, read retries, busy shared pool) must NOT be
+                # misread as a drop — a timeout here would reconnect and
+                # make the server restart the same step's decode, livelocking
+                # when a step reliably exceeds the timeout. Dead peers are
+                # covered by TCP keepalive + close() unblocking the recv.
+                sock.settimeout(None)
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+                return sock, reply
+            except P.ProtocolError:
+                if sock is not None:
+                    sock.close()
+                raise
+            except (ConnectionError, OSError) as exc:
+                if sock is not None:
+                    sock.close()
+                last = exc
+                self.counters.add("connect_retries")
+                backoff = self.backoff_s * (2**attempt)
+                if stop is not None:
+                    if stop.wait(backoff):  # interruptible backoff
+                        raise ConnectionError(
+                            "loader closed during connect"
+                        ) from exc
+                else:
+                    time.sleep(backoff)
+        raise ConnectionError(
+            f"data service {self.host}:{self.port} unreachable after "
+            f"{self.connect_retries} attempts: {last}"
+        ) from last
+
+    def __len__(self) -> int:
+        """Step count of this shard's plan (probe handshake, cached)."""
+        if self._num_steps is None:
+            sock, _ = self._connect(0, probe=True)
+            sock.close()
+        return int(self._num_steps)
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reshuffle parity with ``MapStylePipeline.set_epoch`` — the next
+        ``__iter__`` requests the new epoch's plan (step count may differ
+        only through the plan cache, so invalidate it)."""
+        if epoch != self.epoch:
+            self.epoch = epoch
+            self._num_steps = None
+
+    # -- iteration ---------------------------------------------------------
+
+    def _receive(self, q: "queue.Queue", stop: threading.Event) -> None:
+        """Receiver thread: stream frames into the bounded queue, ACK each
+        received step, reconnect at the cursor on connection loss."""
+        next_step = 0  # resume cursor: first step not yet enqueued
+        sock: Optional[socket.socket] = None
+        try:
+            sock, _ = self._connect(next_step, stop=stop)
+            self._conn = sock
+            while not stop.is_set():
+                try:
+                    msg_type, payload = P.recv_msg(sock)
+                except (ConnectionError, OSError) as exc:
+                    if stop.is_set():
+                        return
+                    # Mid-epoch drop: resume at the cursor. The already-
+                    # enqueued steps [0, next_step) are safe in q, so the
+                    # stream stays exactly-once end to end.
+                    self.counters.add("reconnects")
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    sock, _ = self._connect(next_step, stop=stop)
+                    self._conn = sock
+                    continue
+                if msg_type == P.MSG_BATCH:
+                    step, batch = P.decode_batch(payload["raw"])
+                    if step != next_step:
+                        raise P.ProtocolError(
+                            f"out-of-order step {step}, expected {next_step}"
+                        )
+                    next_step += 1
+                    try:
+                        P.send_msg(sock, P.MSG_ACK, {"step": step})
+                    except (ConnectionError, OSError):
+                        pass  # the next recv sees the drop and reconnects
+                    self.counters.add("batches_received")
+                    t0 = time.perf_counter()
+                    q.put(batch)
+                    # Receiver blocked = trainer slower than the service.
+                    self.counters.add(
+                        "recv_backpressure_s", time.perf_counter() - t0
+                    )
+                elif msg_type == P.MSG_END:
+                    q.put(_SENTINEL)
+                    return
+                elif msg_type == P.MSG_ERROR:
+                    raise RuntimeError(
+                        f"data service error: {payload.get('message')}"
+                    )
+                else:
+                    raise P.ProtocolError(f"unexpected message {msg_type}")
+        except BaseException as exc:  # surface to the consumer
+            q.put(exc)
+        finally:
+            self._conn = None
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def __iter__(self) -> Iterator[dict]:
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        receiver = threading.Thread(
+            target=self._receive, args=(q, stop), daemon=True,
+            name="ldt-remote-loader",
+        )
+        receiver.start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = q.get()
+                # Consumer blocked on an empty queue: the wire (or the
+                # service's decode) is the bottleneck — the client-side
+                # stall the progress lines attribute via attach_counters.
+                self.counters.add("client_stall_s", time.perf_counter() - t0)
+                if item is _SENTINEL:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                if self.device_put_fn is not None:
+                    item = self.device_put_fn(item)
+                yield item
+        finally:
+            stop.set()
+            conn = self._conn
+            if conn is not None:
+                # recv_msg may be blocked on a healthy-but-idle socket;
+                # closing it unblocks the receiver thread immediately.
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            while receiver.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    receiver.join(timeout=0.1)

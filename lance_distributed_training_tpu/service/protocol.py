@@ -1,0 +1,234 @@
+"""Wire protocol for the disaggregated input-data service.
+
+Framing is deliberately dumb: every message is one length-prefixed frame
+
+    u32 big-endian payload length | u8 message type | payload
+
+so both ends can parse with two exact reads and no streaming parser state.
+Control payloads (handshake, acks, errors) are small JSON dicts — never
+pickle: a service port reachable by untrusted peers must not hand
+``pickle.loads`` attacker bytes (arbitrary code execution via
+``__reduce__``), and the control schema is plain strings/ints anyway.
+
+Batch payloads keep the bulk data raw: a batch frame is
+
+    u32 meta length | JSON meta {step, tensors: [[name, dtype, shape], ...]}
+    | tensor 0 raw bytes | tensor 1 raw bytes | ...
+
+with each tensor C-contiguous, so the receive path is one big
+``recvmsg``-style copy per tensor straight into a numpy buffer — the
+device-ready host batch the trainer feeds to ``make_global_batch`` without
+another conversion (the same ``dict[str, np.ndarray]`` contract
+``decode_fn`` produces for the in-process ``DataPipeline``).
+
+The handshake is versioned: a client opens with HELLO carrying
+``PROTOCOL_VERSION``; the server answers HELLO_OK (echoing its version and
+the plan's step count) or ERROR — a version skew fails loudly at connect
+time, never as a mid-epoch deserialisation crash.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "PROTOCOL_VERSION",
+    "MSG_HELLO",
+    "MSG_HELLO_OK",
+    "MSG_BATCH",
+    "MSG_ACK",
+    "MSG_END",
+    "MSG_ERROR",
+    "send_frame",
+    "recv_frame",
+    "send_msg",
+    "recv_msg",
+    "encode_batch",
+    "decode_batch",
+    "ProtocolError",
+]
+
+PROTOCOL_VERSION = 1
+
+# Message types (one byte on the wire).
+MSG_HELLO = 1  # client -> server: version + shard/plan parameters
+MSG_HELLO_OK = 2  # server -> client: version + num_steps + start_step
+MSG_BATCH = 3  # server -> client: one plan step's decoded host batch
+MSG_ACK = 4  # client -> server: cursor advance {"step": n}
+MSG_END = 5  # server -> client: plan exhausted, stream complete
+MSG_ERROR = 6  # either direction: {"message": str}; connection closes after
+
+_HEADER = struct.Struct(">IB")  # frame length (excluding header) | msg type
+_META_LEN = struct.Struct(">I")
+
+# Refuse absurd frames before allocating: the largest legitimate frame is one
+# decoded global batch (e.g. 1024 x 224 x 224 x 3 u8 ~ 154 MB); 2 GiB means a
+# corrupt or hostile peer.
+MAX_FRAME = 2**31
+
+
+class ProtocolError(RuntimeError):
+    """Framing/handshake violation — the connection is unusable."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    """Read exactly ``n`` bytes or raise ``ConnectionError`` on EOF."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed mid-frame")
+        got += r
+    return buf
+
+
+def send_frame(sock: socket.socket, msg_type: int, payload: bytes) -> None:
+    if len(payload) >= MAX_FRAME:
+        raise ProtocolError(f"frame too large: {len(payload)} bytes")
+    header = _HEADER.pack(len(payload), msg_type)
+    if len(payload) > 1 << 16:
+        # Bulk frames (batches): two sendalls instead of concatenating —
+        # header+payload would copy the whole multi-MB batch once more per
+        # step per client on the server's hot path.
+        sock.sendall(header)
+        sock.sendall(payload)
+    else:
+        sock.sendall(header + payload)
+
+
+def recv_frame(sock: socket.socket) -> Tuple[int, bytearray]:
+    header = _recv_exact(sock, _HEADER.size)
+    length, msg_type = _HEADER.unpack(header)
+    if length >= MAX_FRAME:
+        raise ProtocolError(f"frame too large: {length} bytes")
+    return msg_type, _recv_exact(sock, length)
+
+
+def send_msg(sock: socket.socket, msg_type: int, payload: dict) -> None:
+    """Send a control message (JSON dict payload — never pickle: control
+    frames arrive from the network before any trust is established)."""
+    send_frame(sock, msg_type, json.dumps(payload).encode("utf-8"))
+
+
+def recv_msg(sock: socket.socket) -> Tuple[int, dict]:
+    """Receive any frame; control payloads are JSON-decoded, batch frames
+    are returned raw under ``{"raw": bytearray}`` for :func:`decode_batch`."""
+    msg_type, payload = recv_frame(sock)
+    if msg_type == MSG_BATCH:
+        return msg_type, {"raw": payload}
+    try:
+        out = json.loads(bytes(payload).decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise ProtocolError(
+            f"undecodable control frame type {msg_type}: {exc}"
+        )
+    if not isinstance(out, dict):
+        raise ProtocolError(f"control frame type {msg_type} is not a dict")
+    return msg_type, out
+
+
+def encode_batch(step: int, batch: dict) -> bytes:
+    """One plan step's host batch → a MSG_BATCH payload.
+
+    Arrays are serialised raw (C-contiguous dtype/shape + buffer), never
+    pickled — the hot path moves bytes, not objects.
+    """
+    metas, buffers = [], []
+    for name, arr in batch.items():
+        arr = np.ascontiguousarray(arr)
+        metas.append([name, arr.dtype.str, list(arr.shape)])
+        buffers.append(arr.data if arr.size else b"")
+    meta = json.dumps({"step": int(step), "tensors": metas}).encode("utf-8")
+    parts = [_META_LEN.pack(len(meta)), meta]
+    parts.extend(buffers)
+    return b"".join(parts)
+
+
+def decode_batch(payload) -> Tuple[int, dict]:
+    """MSG_BATCH payload → ``(step, {name: np.ndarray})``.
+
+    Arrays are copies (the frame buffer is reused by the receive loop), each
+    materialised with one ``frombuffer`` + reshape — no element-wise work.
+    """
+    view = memoryview(payload)
+    if len(view) < _META_LEN.size:
+        raise ProtocolError("batch frame shorter than its meta header")
+    (meta_len,) = _META_LEN.unpack_from(view, 0)
+    offset = _META_LEN.size
+    if len(view) < offset + meta_len:
+        raise ProtocolError("batch frame truncated inside meta")
+    try:
+        meta = json.loads(bytes(view[offset : offset + meta_len]))
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise ProtocolError(f"undecodable batch meta: {exc}")
+    offset += meta_len
+    out = {}
+    for name, dtype_str, shape in meta["tensors"]:
+        dtype = np.dtype(dtype_str)
+        shape = tuple(shape)
+        nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+        if len(view) < offset + nbytes:
+            raise ProtocolError(f"batch frame truncated inside tensor {name!r}")
+        out[name] = (
+            np.frombuffer(view[offset : offset + nbytes], dtype=dtype)
+            .reshape(shape)
+            .copy()
+        )
+        offset += nbytes
+    if offset != len(view):
+        raise ProtocolError(
+            f"batch frame has {len(view) - offset} trailing bytes"
+        )
+    return int(meta["step"]), out
+
+
+def hello(
+    *,
+    batch_size: int,
+    process_index: int,
+    process_count: int,
+    sampler_type: str = "batch",
+    shuffle: bool = False,
+    seed: int = 0,
+    epoch: int = 0,
+    start_step: int = 0,
+    columns: Optional[list] = None,
+    client_id: str = "",
+    probe: bool = False,
+    task_type: Optional[str] = None,
+    image_size: Optional[int] = None,
+) -> dict:
+    """Build the HELLO payload — the client's shard-of-the-plan request.
+
+    ``start_step`` is the resume cursor: a reconnecting client passes
+    ``last_acked + 1`` and the server serves the identical plan from there
+    (no duplicated, no skipped step). ``probe=True`` asks for HELLO_OK only
+    (plan metadata, e.g. ``len(loader)``) with no batch stream.
+    ``task_type``/``image_size``, when given, let the server reject a
+    decode-config skew at connect time (a 224px server feeding a 299px
+    trainer would otherwise train silently at the wrong resolution — global
+    pooling accepts any spatial size).
+    """
+    return {
+        "version": PROTOCOL_VERSION,
+        "batch_size": int(batch_size),
+        "process_index": int(process_index),
+        "process_count": int(process_count),
+        "sampler_type": sampler_type,
+        "shuffle": bool(shuffle),
+        "seed": int(seed),
+        "epoch": int(epoch),
+        "start_step": int(start_step),
+        "columns": list(columns) if columns is not None else None,
+        "client_id": client_id,
+        "probe": bool(probe),
+        "task_type": task_type,
+        "image_size": int(image_size) if image_size is not None else None,
+    }

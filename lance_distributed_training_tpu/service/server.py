@@ -1,0 +1,468 @@
+"""``DataService`` — the server half of the disaggregated input-data plane.
+
+The in-process pipeline (``data/pipeline.py``) confines decode parallelism
+to the training host: a TPU host with a handful of cores caps decode
+throughput no matter how many chips sit behind it. This service moves the
+whole "read plan → decode → host batch" stage onto independently-scaled CPU
+hosts (the tf.data-service disaggregation argument): a ``DataService``
+process opens the columnar dataset by URI, builds the *same* epoch ``Plan``
+the in-process pipeline builds (``data/samplers.py`` — so batches are
+bit-identical to local training on the same seed), fans decode out over its
+local :class:`~..data.workers.WorkerPool` (or the native decoder's thread
+pool), and streams *per-client-shard, plan-ordered, device-ready host
+batches* over TCP.
+
+Robustness model (the r04/r05 outage history is the motivation):
+
+* every client gets a **bounded queue** — one slow trainer never buffers
+  unbounded memory server-side, and backpressure propagates to decode;
+* clients ACK each received step; a client that reconnects resumes at
+  ``last_acked + 1`` of the identical deterministic plan — no duplicated,
+  no skipped step (the server is stateless across reconnects: the cursor
+  lives in the HELLO);
+* dataset reads retry with exponential backoff before the error frame is
+  sent — a transient storage blip does not kill the epoch.
+
+Run it with ``ldt serve-data --dataset_path … --port …`` on CPU hosts and
+point trainers at it with ``--data_service host:port``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import socket
+import threading
+import time
+from typing import Optional
+
+from ..data.format import Dataset
+from ..data.samplers import assert_equal_step_counts, make_plan
+from ..utils.metrics import ServiceCounters
+from . import protocol as P
+
+__all__ = ["ServeConfig", "DataService", "serve"]
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Server-side knobs. Plan parameters (sampler, batch size, shard, seed,
+    epoch) come from each client's handshake — the server is a decode plane,
+    not a training-config owner."""
+
+    dataset_path: str
+    host: str = "0.0.0.0"
+    port: int = 8476  # 0 = ephemeral (the bound port is DataService.port)
+    task_type: str = "classification"  # selects the decode hook
+    image_size: int = 224
+    num_workers: int = 0  # >0: decode in N spawned worker processes
+    queue_depth: int = 4  # per-client bounded batch queue
+    read_retries: int = 3  # dataset-read attempts before ERROR
+    retry_backoff_s: float = 0.05  # doubles per attempt
+    log_every_s: float = 0.0  # >0: periodic stats line to stdout
+
+
+class _ClientSession:
+    """One connected trainer shard: handshake → producer → sender."""
+
+    def __init__(self, service: "DataService", sock: socket.socket,
+                 peer: str):
+        self.service = service
+        self.sock = sock
+        self.peer = peer
+        self.alive = True
+        self.last_acked = -1
+        self.client_id = ""
+        # Clamp to >=1: maxsize=0 would mean UNBOUNDED, silently voiding the
+        # backpressure guarantee (one stalled trainer buffering the whole
+        # remaining epoch server-side).
+        self._q: "queue.Queue" = queue.Queue(
+            maxsize=max(1, service.config.queue_depth)
+        )
+        self._stop = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def run(self) -> None:
+        """Handler-thread entry: handshake, then stream the plan."""
+        svc = self.service
+        try:
+            msg_type, req = P.recv_msg(self.sock)
+            if msg_type != P.MSG_HELLO:
+                raise P.ProtocolError(
+                    f"expected HELLO, got message type {msg_type}"
+                )
+            if req.get("version") != P.PROTOCOL_VERSION:
+                P.send_msg(
+                    self.sock, P.MSG_ERROR,
+                    {"message": (
+                        f"protocol version mismatch: server "
+                        f"{P.PROTOCOL_VERSION}, client {req.get('version')}"
+                    )},
+                )
+                return
+            self.client_id = req.get("client_id", "")
+            skew = svc.decode_config_skew(req)
+            if skew:
+                P.send_msg(self.sock, P.MSG_ERROR, {"message": skew})
+                return
+            plan = svc.plan_for(req)
+            start = int(req.get("start_step", 0))
+            if not 0 <= start <= len(plan):
+                P.send_msg(
+                    self.sock, P.MSG_ERROR,
+                    {"message": (
+                        f"start_step {start} outside plan of {len(plan)} "
+                        "steps"
+                    )},
+                )
+                return
+            self.last_acked = start - 1
+            P.send_msg(
+                self.sock, P.MSG_HELLO_OK,
+                {"version": P.PROTOCOL_VERSION, "num_steps": len(plan),
+                 "start_step": start},
+            )
+            if req.get("probe") or start == len(plan):
+                # Metadata-only connect (len(loader)), or an already-finished
+                # cursor: confirm completion, no stream.
+                if not req.get("probe"):
+                    P.send_msg(self.sock, P.MSG_END, {})
+                return
+            if start > 0:
+                svc.counters.add("resumes")
+            self._stream(plan, start, req)
+        except (ConnectionError, OSError, P.ProtocolError) as exc:
+            # Client vanished or spoke garbage — log via counters, move on.
+            svc.counters.add("client_errors")
+            svc._log(f"client {self.peer}: {exc}")
+        except Exception as exc:  # decode/plan errors: tell the client
+            svc.counters.add("server_errors")
+            svc._log(f"client {self.peer}: {exc!r}")
+            try:
+                P.send_msg(self.sock, P.MSG_ERROR, {"message": repr(exc)})
+            except OSError:
+                pass
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        self.alive = False
+        self._stop.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.service._forget(self)
+
+    # -- streaming ---------------------------------------------------------
+
+    def _stream(self, plan, start: int, req: dict) -> None:
+        svc = self.service
+        producer = threading.Thread(
+            target=self._produce, args=(plan, start, req), daemon=True,
+            name=f"ldt-svc-produce-{self.peer}",
+        )
+        producer.start()
+        acker = threading.Thread(
+            target=self._read_acks, daemon=True,
+            name=f"ldt-svc-ack-{self.peer}",
+        )
+        acker.start()
+        try:
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    # Bounded wait, not a bare get(): when the client drops
+                    # with the queue empty, the producer exits on the stop
+                    # flag WITHOUT enqueuing a sentinel — a blocking get
+                    # would strand this thread (and its session) forever.
+                    item = self._q.get(timeout=0.25)
+                except queue.Empty:
+                    svc.counters.add(
+                        "queue_empty_s", time.perf_counter() - t0
+                    )
+                    continue
+                # Sender idle = decode is the bottleneck for this client.
+                svc.counters.add("queue_empty_s", time.perf_counter() - t0)
+                if item is None:  # producer finished the plan
+                    P.send_msg(self.sock, P.MSG_END, {})
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                step, payload = item
+                P.send_frame(self.sock, P.MSG_BATCH, payload)
+                svc.counters.add("batches_sent")
+                svc.counters.add("bytes_sent", len(payload))
+        finally:
+            self._stop.set()
+            # Unblock a producer waiting on a full queue so it can exit.
+            while producer.is_alive():
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    producer.join(timeout=0.1)
+
+    def _produce(self, plan, start: int, req: dict) -> None:
+        """Decode plan items [start:] into the bounded queue, in order."""
+        svc = self.service
+        try:
+            items = plan[start:]
+            if svc.workers is not None:
+                results = svc.workers.imap(items)
+            else:
+                columns = req.get("columns")
+                results = (
+                    svc.decode_fn(svc.read_item(item, columns))
+                    for item in items
+                )
+            for offset, batch in enumerate(results):
+                if self._stop.is_set():
+                    return
+                payload = P.encode_batch(start + offset, batch)
+                t0 = time.perf_counter()
+                self._q.put((start + offset, payload))
+                # Producer blocked = this client consumes slower than decode.
+                svc.counters.add("queue_full_s", time.perf_counter() - t0)
+                svc.counters.gauge("queue_depth", self._q.qsize())
+            self._q.put(None)
+        except BaseException as exc:  # surface to the sender loop
+            self._q.put(exc)
+
+    def _read_acks(self) -> None:
+        """Drain client ACKs; EOF here means the client is gone."""
+        try:
+            while not self._stop.is_set():
+                msg_type, msg = P.recv_msg(self.sock)
+                if msg_type == P.MSG_ACK:
+                    self.last_acked = max(self.last_acked, int(msg["step"]))
+                    self.service.counters.gauge(
+                        "last_acked", self.last_acked
+                    )
+                elif msg_type == P.MSG_ERROR:
+                    self.service._log(
+                        f"client {self.peer} error: {msg.get('message')}"
+                    )
+                    break
+        except (ConnectionError, OSError, P.ProtocolError):
+            pass
+        finally:
+            # Sender may be blocked in sendall on a dead peer; closing the
+            # socket breaks it out.
+            self._stop.set()
+            try:
+                self.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+
+class DataService:
+    """Serve plan-ordered decoded batches to remote trainers over TCP."""
+
+    def __init__(self, config: ServeConfig):
+        from ..data.decode import decoder_for_task
+
+        self.config = config
+        self.dataset = Dataset(config.dataset_path)
+        # The SAME dispatch the trainer uses — the bit-identical-batches
+        # guarantee depends on both sides binding one decoder implementation.
+        self.decode_fn = decoder_for_task(config.task_type, config.image_size)
+        self.counters = ServiceCounters()
+        self.workers = None
+        if config.num_workers > 0:
+            from ..data.workers import WorkerPool, columnar_spec
+
+            self.workers = WorkerPool(
+                columnar_spec(config.dataset_path),
+                self.decode_fn,
+                config.num_workers,
+                columns=getattr(self.decode_fn, "required_columns", None),
+                read_retries=config.read_retries,
+                retry_backoff_s=config.retry_backoff_s,
+            )
+        self._plans: dict = {}  # handshake params -> per-process plans
+        self._plans_lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._sessions: set = set()
+        self._sessions_lock = threading.Lock()
+        self._stopped = threading.Event()
+        self.port: Optional[int] = None
+
+    # -- data plane --------------------------------------------------------
+
+    def read_item(self, item, columns=None):
+        """One plan item (list of ReadRange) → Arrow table (the pipeline's
+        own range-read helper), with retry + exponential backoff on
+        transient storage failures. The worker-pool path retries inside the
+        workers (WorkerPool(read_retries=…)) with the same policy."""
+        from ..data.pipeline import _range_read
+        from ..data.workers import RETRYABLE_READ_ERRORS
+
+        cfg = self.config
+        retries = max(1, cfg.read_retries)
+        last: Optional[Exception] = None
+        for attempt in range(retries):
+            try:
+                return _range_read(self.dataset, item, columns=columns)
+            except RETRYABLE_READ_ERRORS as exc:
+                last = exc
+                self.counters.add("read_retries")
+                if attempt + 1 < retries:  # no sleep after the final failure
+                    time.sleep(cfg.retry_backoff_s * (2**attempt))
+        raise RuntimeError(
+            f"dataset read failed after {retries} attempts: {last}"
+        ) from last
+
+    def decode_config_skew(self, req: dict) -> Optional[str]:
+        """Reject decode-config mismatches at connect time. A 224px server
+        feeding a 299px trainer trains silently at the wrong resolution
+        (global pooling accepts any spatial size), so when the client
+        declares its decode knobs they must match this server's."""
+        cfg = self.config
+        task = req.get("task_type")
+        if task is not None and task != cfg.task_type:
+            return (
+                f"decode-config skew: server serves task_type="
+                f"{cfg.task_type!r}, client expects {task!r}"
+            )
+        size = req.get("image_size")
+        if (
+            size is not None
+            and cfg.task_type in ("classification", "contrastive")
+            and int(size) != cfg.image_size
+        ):
+            return (
+                f"decode-config skew: server decodes image_size="
+                f"{cfg.image_size}, client expects {size}"
+            )
+        return None
+
+    def plan_for(self, req: dict):
+        """This shard's epoch plan — identical to the in-process pipeline's
+        (same ``make_plan`` pure function, same equal-step validation across
+        ALL shards so the collective-deadlock guard still runs even though
+        training happens elsewhere)."""
+        key = (
+            req["sampler_type"], int(req["batch_size"]),
+            int(req["process_count"]), bool(req.get("shuffle")),
+            int(req.get("seed", 0)), int(req.get("epoch", 0)),
+        )
+        pidx = int(req["process_index"])
+        pcount = int(req["process_count"])
+        if not 0 <= pidx < pcount:
+            raise ValueError(f"invalid shard {pidx} of {pcount}")
+        if req["sampler_type"] in ("full", "full_scan") and pcount > 1:
+            # Mirror make_train_pipeline's refusal — every shard would get
+            # the identical whole-dataset plan and multi-process training
+            # would silently duplicate every row process_count times.
+            raise ValueError(
+                "sampler_type='full' is not DP-aware (every process scans "
+                f"the whole dataset) and cannot serve {pcount} processes; "
+                "use sampler_type='batch' or 'fragment'"
+            )
+        with self._plans_lock:
+            plans = self._plans.get(key)
+            if plans is None:
+                rows = self.dataset.fragment_rows()
+                sampler, bs, count, shuffle, seed, epoch = key
+                plans = [
+                    make_plan(sampler, rows, bs, p, count,
+                              shuffle=shuffle, seed=seed, epoch=epoch)
+                    for p in range(count)
+                ]
+                if sampler not in ("full", "full_scan"):
+                    assert_equal_step_counts(plans, bs)
+                if len(self._plans) >= 8:  # old epochs: evict oldest entry
+                    self._plans.pop(next(iter(self._plans)))
+                self._plans[key] = plans
+        return plans[pidx]
+
+    # -- control plane -----------------------------------------------------
+
+    def start(self) -> "DataService":
+        """Bind + listen + accept in a background thread. Returns self; the
+        bound port (for ``port=0``) is ``self.port``."""
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self.config.host, self.config.port))
+        sock.listen(64)
+        self._sock = sock
+        self.port = sock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="ldt-svc-accept"
+        )
+        self._accept_thread.start()
+        self._log(
+            f"serving {self.config.dataset_path} on "
+            f"{self.config.host}:{self.port}"
+        )
+        return self
+
+    def _accept_loop(self) -> None:
+        assert self._sock is not None
+        while not self._stopped.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:  # listener closed by stop()
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            session = _ClientSession(self, conn, f"{addr[0]}:{addr[1]}")
+            with self._sessions_lock:
+                self._sessions.add(session)
+            self.counters.gauge("active_clients", len(self._sessions))
+            threading.Thread(
+                target=session.run, daemon=True,
+                name=f"ldt-svc-client-{addr[1]}",
+            ).start()
+
+    def _forget(self, session: _ClientSession) -> None:
+        with self._sessions_lock:
+            self._sessions.discard(session)
+        self.counters.gauge("active_clients", len(self._sessions))
+
+    def serve_forever(self) -> None:
+        """Blocking serve (the ``ldt serve-data`` entry): start if needed,
+        then wait for stop()/KeyboardInterrupt, optionally logging stats."""
+        if self._sock is None:
+            self.start()
+        try:
+            interval = self.config.log_every_s
+            while not self._stopped.wait(interval if interval > 0 else 3600.0):
+                if interval > 0:
+                    self._log(str(self.counters.snapshot()))
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._sessions_lock:
+            sessions = list(self._sessions)
+        for s in sessions:
+            s.close()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+        if self.workers is not None:
+            self.workers.shutdown()
+            self.workers = None
+
+    def __enter__(self) -> "DataService":
+        return self.start() if self._sock is None else self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _log(self, msg: str) -> None:
+        print(f"[data-service] {msg}", flush=True)
+
+
+def serve(config: ServeConfig) -> None:
+    """Module-level convenience for the CLI."""
+    DataService(config).serve_forever()

@@ -36,7 +36,6 @@ import numpy as np
 import optax
 from flax.training import train_state
 
-from .data.decode import ImageClassificationDecoder, numeric_decoder
 from .data.format import Dataset
 from .data.pipeline import MapStylePipeline, make_train_pipeline
 from .models.tasks import Task, get_task
@@ -111,6 +110,11 @@ class TrainConfig:
     grad_clip: float = 0.0  # >0: clip gradients by global norm
     grad_accum: int = 1  # >1: accumulate N micro-steps per optimizer update
     num_workers: int = 0  # >0: decode in N worker processes (get_safe_loader parity)
+    data_service_addr: Optional[str] = None  # host:port of a running
+    # `ldt serve-data` DataService: decode runs on that host's fleet and this
+    # process streams plan-ordered device-ready batches (RemoteLoader) —
+    # identical batches to local training on the same seed. Iterable columnar
+    # path only; decode knobs (task_type/image_size) must match server-side.
     no_ddp: bool = False  # single-device escape hatch (lance_iterable.py:145)
     no_wandb: bool = False  # lance_iterable.py:146
     model_name: Optional[str] = None  # default per task (resnet50 / bert_base / clip)
@@ -482,15 +486,9 @@ def evaluate(state, loader, eval_step) -> float:
 
 
 def _decoder_for(config: TrainConfig):
-    if config.task_type == "classification":
-        return ImageClassificationDecoder(image_size=config.image_size)
-    if config.task_type in ("masked_lm", "causal_lm"):
-        return numeric_decoder
-    if config.task_type == "contrastive":
-        from .data.decode import ImageTextDecoder
+    from .data.decode import decoder_for_task
 
-        return ImageTextDecoder(image_size=config.image_size)
-    raise ValueError(f"Invalid task type: {config.task_type}")
+    return decoder_for_task(config.task_type, config.image_size)
 
 
 def _make_worker_pool(config: TrainConfig, dataset):
@@ -530,6 +528,34 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
         mesh=mesh,
         seq_axis="seq" if config.seq_parallelism > 1 else None,
     )
+    if config.data_service_addr:
+        # Disaggregated input plane: decode runs in the remote DataService;
+        # this process only streams host batches and dispatches device_put.
+        # The server builds the identical epoch Plan (same make_plan), so
+        # batches match local training bit-for-bit on the same seed.
+        from .service.client import RemoteLoader
+
+        loader = RemoteLoader(
+            config.data_service_addr,
+            per_process,
+            process_index,
+            process_count,
+            put,
+            sampler_type=config.sampler_type,
+            shuffle=config.shuffle,
+            seed=config.seed,
+            epoch=epoch,
+            prefetch=config.prefetch,
+            columns=getattr(decode, "required_columns", None),
+            task_type=config.task_type,
+            image_size=config.image_size,
+        )
+        if len(loader) == 0:
+            raise ValueError(
+                "empty plan from data service: dataset smaller than one "
+                f"global batch ({config.batch_size})"
+            )
+        return loader
     if config.filter and config.data_format != "columnar":
         raise ValueError("filter= needs the columnar store (data_format="
                          "'columnar'); folder trees have no row predicates")
@@ -799,6 +825,27 @@ def train(config: TrainConfig) -> dict:
                 "val_fraction needs the map-style columnar path (the split "
                 "is an index pool); pass loader_style='map'"
             )
+    if config.data_service_addr:
+        if config.data_format != "columnar" or config.loader_style != "iterable":
+            raise ValueError(
+                "data_service_addr needs the iterable columnar path (the "
+                "service streams sampler-plan ranges); pass "
+                "loader_style='iterable', data_format='columnar'"
+            )
+        if config.filter or config.val_fraction:
+            raise ValueError(
+                "filter/val_fraction resolve index pools locally and cannot "
+                "combine with data_service_addr"
+            )
+        if config.num_workers > 0:
+            import warnings
+
+            warnings.warn(
+                "num_workers>0 has no effect with data_service_addr: decode "
+                "runs in the remote DataService (size ITS pool with "
+                "`ldt serve-data --num_workers N`)",
+                stacklevel=2,
+            )
     maybe_initialize_distributed(
         config.coordinator_address, config.num_processes, config.process_id
     )
@@ -814,9 +861,30 @@ def train(config: TrainConfig) -> dict:
         pipe_parallelism=config.pipeline_parallelism,
     )
 
-    dataset = (
-        Dataset(config.dataset_path) if config.data_format == "columnar" else None
-    )
+    if config.data_format != "columnar":
+        dataset = None
+    elif config.data_service_addr:
+        # Disaggregated runs: the TPU host may not mount the dataset path at
+        # all — train-side reads happen on the service host. Open locally
+        # only if present (it unlocks eval + schedule-horizon derivation).
+        try:
+            dataset = Dataset(config.dataset_path)
+        except FileNotFoundError:
+            dataset = None
+    else:
+        dataset = Dataset(config.dataset_path)
+    if (
+        dataset is None
+        and config.data_service_addr
+        and (config.eval_at_end or config.eval_every)
+        and not config.val_dataset_path
+    ):
+        raise ValueError(
+            "eval needs the dataset readable on this host (eval reads rows "
+            f"directly, not through the data service): {config.dataset_path} "
+            "is absent — mount it, pass val_dataset_path, or disable eval "
+            "(eval_at_end=False, eval_every=0)"
+        )
     val_dataset = (
         Dataset(config.val_dataset_path)
         if config.val_dataset_path and config.data_format == "columnar"
@@ -856,6 +924,12 @@ def train(config: TrainConfig) -> dict:
             rows = len(index_pool)
         elif dataset is not None:
             rows = dataset.count_rows()
+        elif config.data_service_addr:
+            raise ValueError(
+                "lr_schedule needs a horizon, and the dataset is not "
+                "readable on this host to derive one — pass total_steps "
+                "explicitly with data_service_addr"
+            )
         else:
             from .data.authoring import _folder_samples
 
@@ -942,7 +1016,9 @@ def train(config: TrainConfig) -> dict:
 
     profiling = False
 
-    worker_pool = _make_worker_pool(config, dataset)
+    worker_pool = (
+        None if config.data_service_addr else _make_worker_pool(config, dataset)
+    )
     try:
         return _train_loop(
             config, dataset, val_dataset, mesh, state, rng, train_step,
@@ -1003,6 +1079,12 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             loader = _build_loader(config, dataset, mesh, epoch, worker_pool,
                                    index_pool=index_pool)
             it = iter(loader)
+        # RemoteLoader exposes ServiceCounters: merge its stall/queue window
+        # into per-step progress lines so loader-stall% stays attributable
+        # (client receive stall vs server queue vs device). None detaches.
+        timer.attach_counters(
+            getattr(loader, "counters", None) if loader is not None else None
+        )
         filling = cache_ok and not replay
         timer.reset()
         epoch_start = time.perf_counter()
@@ -1105,6 +1187,12 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                             100.0 * w["loader_s"] / wt if wt else 0.0
                         ),
                     }
+                    # Data-service windows (RemoteLoader counters attached
+                    # to the timer): svc_client_stall_s, svc_reconnects, …
+                    entry.update({
+                        k: round(v, 4) if isinstance(v, float) else v
+                        for k, v in w.items() if k.startswith("svc_")
+                    })
                     if lr_fn is not None:
                         # Schedules count optimizer updates, not
                         # micro-steps; base_step carries the restored

@@ -1,4 +1,4 @@
 """Utilities: metrics logging, timing, checkpointing, profiling."""
 
-from .metrics import MetricLogger, StepTimer  # noqa: F401
+from .metrics import MetricLogger, ServiceCounters, StepTimer  # noqa: F401
 from .profiling import StepProfile, annotate, trace  # noqa: F401

@@ -13,12 +13,65 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Optional
 
 import jax
 
-__all__ = ["MetricLogger", "StepTimer"]
+__all__ = ["MetricLogger", "StepTimer", "ServiceCounters"]
+
+
+class ServiceCounters:
+    """Thread-safe counters + gauges for the disaggregated data service.
+
+    Both halves of the service report here: the server accumulates per-client
+    queue depth / send counts / producer stalls (client slower than decode),
+    the ``RemoteLoader`` accumulates receive stalls (decode slower than
+    client), reconnects, and bytes. Attached to a :class:`StepTimer` (or read
+    via :meth:`window`), the deltas land in the per-``log_every`` progress
+    lines so loader-stall%% stays attributable to a specific side of the wire.
+    """
+
+    def __init__(self, prefix: str = "svc"):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._counts: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._window: dict[str, float] = {}
+
+    def add(self, key: str, value: float = 1.0) -> None:
+        """Accumulate a monotonically-growing counter (stall seconds, batches
+        served, reconnects, bytes)."""
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0.0) + value
+
+    def gauge(self, key: str, value: float) -> None:
+        """Set an instantaneous gauge (queue depth, active clients)."""
+        with self._lock:
+            self._gauges[key] = float(value)
+
+    def snapshot(self) -> dict:
+        """Current totals + gauges, keys prefixed (``svc_*``)."""
+        with self._lock:
+            out = {f"{self.prefix}_{k}": v for k, v in self._counts.items()}
+            out.update(
+                {f"{self.prefix}_{k}": v for k, v in self._gauges.items()}
+            )
+        return out
+
+    def window(self) -> dict:
+        """Counter deltas since the previous ``window()`` call, plus current
+        gauges — the per-``log_every`` view ``StepTimer.window`` merges in."""
+        with self._lock:
+            out = {}
+            for k, v in self._counts.items():
+                out[f"{self.prefix}_{k}"] = v - self._window.get(k, 0.0)
+                self._window[k] = v
+            out.update(
+                {f"{self.prefix}_{k}": v for k, v in self._gauges.items()}
+            )
+        return out
 
 
 class MetricLogger:
@@ -99,6 +152,7 @@ class StepTimer:
     """
 
     def __init__(self):
+        self._counters: Optional[ServiceCounters] = None
         self.reset()
 
     def reset(self) -> None:
@@ -109,6 +163,13 @@ class StepTimer:
         self._w_loader = 0.0
         self._w_step = 0.0
         self._w_steps = 0
+
+    def attach_counters(self, counters: Optional[ServiceCounters]) -> None:
+        """Merge a :class:`ServiceCounters` window into every ``window()``:
+        when the loader is a ``RemoteLoader``, per-step progress lines then
+        carry svc_* stall/queue fields next to loader_s, so a stall spike is
+        attributable (server queue empty vs client receive vs device)."""
+        self._counters = counters
 
     def window(self) -> dict:
         """Deltas since the previous ``window()`` call (or ``reset``) — the
@@ -121,6 +182,8 @@ class StepTimer:
         self._w_loader = self.loader_s
         self._w_step = self.step_s
         self._w_steps = self.steps
+        if self._counters is not None:
+            out.update(self._counters.window())
         return out
 
     def loader_start(self) -> None:

@@ -1,0 +1,357 @@
+"""Disaggregated input-data service: wire protocol, loopback end-to-end
+parity with the in-process pipeline, and reconnect-resumes-at-cursor.
+
+All fast (`not slow`): the loopback server runs in-thread on 127.0.0.1 with
+tiny 32px JPEG batches — no jit, no process pool.
+"""
+
+import socket
+
+import numpy as np
+import pytest
+
+from lance_distributed_training_tpu.data import ImageClassificationDecoder
+from lance_distributed_training_tpu.data.pipeline import make_train_pipeline
+from lance_distributed_training_tpu.service import (
+    DataService,
+    RemoteLoader,
+    ServeConfig,
+)
+from lance_distributed_training_tpu.service import protocol as P
+
+
+# -- protocol unit tests ----------------------------------------------------
+
+
+def test_batch_roundtrip_dtypes():
+    batch = {
+        "image": np.arange(2 * 4 * 4 * 3, dtype=np.uint8).reshape(2, 4, 4, 3),
+        "label": np.array([3, -7], dtype=np.int32),
+        "weight": np.array([0.5, 1.0], dtype=np.float32),
+        "empty": np.empty((0, 5), dtype=np.float64),
+    }
+    step, out = P.decode_batch(P.encode_batch(17, batch))
+    assert step == 17
+    assert set(out) == set(batch)
+    for k in batch:
+        assert out[k].dtype == batch[k].dtype
+        np.testing.assert_array_equal(out[k], batch[k])
+
+
+def test_batch_decode_rejects_truncation():
+    payload = P.encode_batch(0, {"x": np.ones((4, 4), np.float32)})
+    with pytest.raises(P.ProtocolError, match="truncated"):
+        P.decode_batch(payload[:-8])
+
+
+def test_frame_roundtrip_over_socketpair():
+    a, b = socket.socketpair()
+    try:
+        P.send_msg(a, P.MSG_ACK, {"step": 5})
+        msg_type, msg = P.recv_msg(b)
+        assert msg_type == P.MSG_ACK and msg["step"] == 5
+        a.close()
+        with pytest.raises(ConnectionError):
+            P.recv_msg(b)
+    finally:
+        b.close()
+
+
+# -- loopback service fixtures ---------------------------------------------
+
+
+@pytest.fixture()
+def service(image_dataset):
+    svc = DataService(ServeConfig(
+        dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+        image_size=32, queue_depth=2,
+    )).start()
+    yield svc
+    svc.stop()
+
+
+def _loader(svc, **kw):
+    kw.setdefault("connect_retries", 2)
+    kw.setdefault("backoff_s", 0.01)
+    return RemoteLoader(f"127.0.0.1:{svc.port}", 16, 0, 1, **kw)
+
+
+# -- end-to-end -------------------------------------------------------------
+
+
+def test_remote_matches_inprocess_pipeline(image_dataset, service):
+    """Acceptance: RemoteLoader batches element-wise identical to the
+    DataPipeline's for the same dataset/seed/epoch/shard."""
+    local = list(make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1,
+        ImageClassificationDecoder(image_size=32),
+    ))
+    loader = _loader(service)
+    assert len(loader) == len(local) == 240 // 16
+    remote = list(loader)
+    assert len(remote) == len(local)
+    for a, b in zip(remote, local):
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+
+
+def test_remote_shards_disjoint_and_equal_steps(image_dataset, service):
+    streams = []
+    for p in range(2):
+        loader = RemoteLoader(
+            f"127.0.0.1:{service.port}", 16, p, 2,
+            connect_retries=2, backoff_s=0.01,
+        )
+        streams.append([tuple(b["label"].tolist()) for b in loader])
+    assert len(streams[0]) == len(streams[1]) > 0  # deadlock invariant
+    assert not (set(streams[0]) & set(streams[1]))  # disjoint coverage
+
+
+def test_remote_shuffle_parity_across_epochs(image_dataset, service):
+    """set_epoch reshuffles exactly like the local iterable pipeline."""
+    def local(epoch):
+        pipe = make_train_pipeline(
+            image_dataset, "batch", 16, 0, 1,
+            ImageClassificationDecoder(image_size=32),
+            shuffle=True, seed=7, epoch=epoch,
+        )
+        return [tuple(b["label"].tolist()) for b in pipe]
+
+    loader = _loader(service, shuffle=True, seed=7)
+    e0 = [tuple(b["label"].tolist()) for b in loader]
+    loader.set_epoch(1)
+    e1 = [tuple(b["label"].tolist()) for b in loader]
+    assert e0 == local(0)
+    assert e1 == local(1)
+    assert e0 != e1
+
+
+def test_reconnect_resumes_at_cursor(image_dataset, service):
+    """Acceptance: a mid-epoch disconnect resumes from the acked cursor —
+    no duplicated, no skipped step."""
+    local = list(make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1,
+        ImageClassificationDecoder(image_size=32),
+    ))
+    loader = _loader(service, prefetch=1)
+    it = iter(loader)
+    got = [next(it), next(it)]
+    # Kill the live connection out from under the receiver thread.
+    conn = loader._conn
+    assert conn is not None
+    conn.close()
+    got.extend(it)
+    assert loader.counters.snapshot().get("svc_reconnects", 0) >= 1
+    assert len(got) == len(local)  # nothing skipped, nothing duplicated
+    for a, b in zip(got, local):
+        np.testing.assert_array_equal(a["label"], b["label"])
+        np.testing.assert_array_equal(a["image"], b["image"])
+
+
+def test_fresh_client_resumes_from_explicit_cursor(image_dataset, service):
+    """A brand-new client (crashed trainer) can hand the server a start_step
+    and receive exactly the plan's tail."""
+    local = list(make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1,
+        ImageClassificationDecoder(image_size=32),
+    ))
+    sock, reply = _loader(service)._connect(start_step=3)
+    try:
+        assert reply["num_steps"] == len(local) and reply["start_step"] == 3
+        steps = []
+        while True:
+            msg_type, payload = P.recv_msg(sock)
+            if msg_type == P.MSG_END:
+                break
+            assert msg_type == P.MSG_BATCH
+            step, batch = P.decode_batch(payload["raw"])
+            steps.append(step)
+            np.testing.assert_array_equal(batch["label"], local[step]["label"])
+    finally:
+        sock.close()
+    assert steps == [3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14]
+
+
+def test_device_put_contract(image_dataset, service):
+    """With device_put_fn bound, the trainer-visible contract is the same
+    sharded global jax.Array as every other loader."""
+    import jax
+    from jax.sharding import PartitionSpec as JP
+
+    from lance_distributed_training_tpu.parallel import (
+        get_mesh,
+        make_global_batch,
+    )
+
+    mesh = get_mesh()
+    loader = _loader(
+        service, device_put_fn=lambda b: make_global_batch(b, mesh)
+    )
+    batch = next(iter(loader))
+    assert isinstance(batch["image"], jax.Array)
+    assert batch["image"].sharding.spec == JP("data")
+
+
+def test_early_stop_drains_cleanly(image_dataset, service):
+    loader = _loader(service, prefetch=1)
+    it = iter(loader)
+    next(it)
+    it.close()  # must not hang the receiver thread or the server session
+    # The server must still serve new clients afterwards.
+    assert len(list(_loader(service))) == 240 // 16
+
+
+# -- handshake failure modes ------------------------------------------------
+
+
+def test_version_mismatch_rejected(image_dataset, service):
+    sock = socket.create_connection(("127.0.0.1", service.port), timeout=5)
+    try:
+        bad = P.hello(batch_size=16, process_index=0, process_count=1)
+        bad["version"] = 999
+        P.send_msg(sock, P.MSG_HELLO, bad)
+        msg_type, msg = P.recv_msg(sock)
+        assert msg_type == P.MSG_ERROR
+        assert "version" in msg["message"]
+    finally:
+        sock.close()
+
+
+def test_decode_config_skew_rejected(image_dataset, service):
+    """A trainer expecting a different image_size than the server decodes
+    must be refused at connect time, never trained at the wrong resolution."""
+    loader = _loader(service, image_size=64, task_type="classification")
+    with pytest.raises(P.ProtocolError, match="skew"):
+        len(loader)
+    # Matching declaration connects fine.
+    ok = _loader(service, image_size=32, task_type="classification")
+    assert len(ok) == 240 // 16
+
+
+def test_full_sampler_multiprocess_refused_remotely(image_dataset, service):
+    """Parity with make_train_pipeline's refusal: 'full' is not DP-aware."""
+    loader = RemoteLoader(
+        f"127.0.0.1:{service.port}", 16, 0, 2, sampler_type="full",
+        connect_retries=1, backoff_s=0.01,
+    )
+    with pytest.raises((P.ProtocolError, RuntimeError)):
+        list(loader)
+
+
+def test_client_drop_with_empty_queue_frees_session(image_dataset, service):
+    """A client that handshakes and immediately vanishes (empty per-client
+    queue) must not strand the server's sender thread or leak the session."""
+    import time as _time
+
+    sock, _ = _loader(service)._connect(0)
+    sock.close()  # drop before consuming anything
+    deadline = _time.monotonic() + 10
+    while _time.monotonic() < deadline:
+        with service._sessions_lock:
+            if not service._sessions:
+                break
+        _time.sleep(0.05)
+    with service._sessions_lock:
+        assert not service._sessions  # session reaped, gauge accurate
+    # Server still healthy for the next client.
+    assert len(list(_loader(service))) == 240 // 16
+
+
+def test_bad_shard_rejected(image_dataset, service):
+    loader = RemoteLoader(
+        f"127.0.0.1:{service.port}", 16, 3, 2,  # process 3 of 2
+        connect_retries=1, backoff_s=0.01,
+    )
+    with pytest.raises((P.ProtocolError, RuntimeError)):
+        list(loader)
+
+
+def test_unreachable_service_raises_after_backoff():
+    # Reserve a port and close it so nothing listens there.
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    loader = RemoteLoader(
+        f"127.0.0.1:{port}", 16, 0, 1, connect_retries=2, backoff_s=0.01,
+    )
+    with pytest.raises(ConnectionError, match="unreachable"):
+        len(loader)
+
+
+def test_bad_address_rejected_eagerly():
+    with pytest.raises(ValueError, match="host:port"):
+        RemoteLoader("nonsense", 16, 0, 1)
+
+
+# -- trainer config validation ---------------------------------------------
+
+
+def test_train_config_service_combos():
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    base = dict(dataset_path="/nonexistent", data_service_addr="h:1",
+                no_wandb=True)
+    with pytest.raises(ValueError, match="iterable columnar"):
+        train(TrainConfig(**base, loader_style="map"))
+    with pytest.raises(ValueError, match="iterable columnar"):
+        train(TrainConfig(**base, data_format="folder"))
+    with pytest.raises(ValueError, match="filter"):
+        train(TrainConfig(**base, filter="label < 5"))
+
+
+def test_train_requires_local_dataset_for_eval():
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    with pytest.raises(ValueError, match="eval"):
+        train(TrainConfig(
+            dataset_path="/nonexistent/ds", data_service_addr="h:1",
+            no_wandb=True, eval_at_end=True,
+        ))
+
+
+@pytest.mark.slow
+def test_train_through_service(image_dataset):
+    """Full trainer integration: train() with data_service_addr streams every
+    batch through the loopback service (resnet18 compile — slow tier)."""
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    svc = DataService(ServeConfig(
+        dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+        image_size=32,
+    )).start()
+    try:
+        results = train(TrainConfig(
+            dataset_path=image_dataset.uri,
+            data_service_addr=f"127.0.0.1:{svc.port}",
+            num_classes=10, model_name="resnet18", image_size=32,
+            batch_size=16, epochs=1, no_wandb=True, eval_at_end=False,
+        ))
+        assert np.isfinite(results["loss"])
+        assert results["steps"] == 240 // 16
+        assert svc.counters.snapshot()["svc_batches_sent"] >= results["steps"]
+    finally:
+        svc.stop()
+
+
+def test_serve_cli_parser_roundtrip():
+    from lance_distributed_training_tpu.cli import build_serve_parser
+
+    args = build_serve_parser().parse_args([
+        "--dataset_path", "/d", "--port", "0", "--num_workers", "3",
+        "--queue_depth", "8", "--image_size", "64",
+    ])
+    assert args.port == 0 and args.num_workers == 3
+    assert args.queue_depth == 8 and args.image_size == 64
+
+
+def test_train_cli_data_service_flag(monkeypatch):
+    import lance_distributed_training_tpu.cli as cli
+
+    captured = {}
+    monkeypatch.setattr(
+        cli, "train", lambda config: captured.update(config=config) or {}
+    )
+    cli.main(["train", "--dataset_path", "/d", "--no_wandb",
+              "--data_service", "cpu-host:8476"])
+    assert captured["config"].data_service_addr == "cpu-host:8476"
