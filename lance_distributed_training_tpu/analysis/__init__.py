@@ -1,0 +1,39 @@
+"""Static analysis for distributed-training invariants (``ldt check``).
+
+An AST-based lint subsystem with project-specific rules: plan determinism
+(LDT001-003), jit purity (LDT101-102), concurrency hygiene (LDT201-203),
+resource ownership (LDT301), jax-compat enforcement (LDT401), and
+cross-module wire-protocol consistency (LDT501). Configured under
+``[tool.ldt-check]`` in pyproject.toml; per-line suppression via
+``# ldt: ignore[LDTxxx]``; grandfathered findings live in a baseline file.
+
+Programmatic surface::
+
+    from lance_distributed_training_tpu.analysis import analyze, load_config
+    findings = analyze(repo_root, load_config(repo_root))
+"""
+
+from .config import CheckConfig, load_config  # noqa: F401
+from .core import (  # noqa: F401
+    Finding,
+    ModuleInfo,
+    Rule,
+    all_rules,
+    analyze,
+    analyze_project,
+    register,
+)
+from .cli import check_main  # noqa: F401
+
+__all__ = [
+    "CheckConfig",
+    "Finding",
+    "ModuleInfo",
+    "Rule",
+    "all_rules",
+    "analyze",
+    "analyze_project",
+    "check_main",
+    "load_config",
+    "register",
+]

@@ -1,0 +1,123 @@
+"""``ldt check`` — run the distributed-training lint over the repo.
+
+Exit status is the gate contract: 0 when no NEW findings (relative to the
+baseline, when one exists), 1 when new findings are reported, 2 on usage
+errors. ``--update-baseline`` grandfathers the current findings so the gate
+can be adopted incrementally and ratcheted down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Sequence
+
+from .config import load_config
+from .core import (
+    all_rules,
+    analyze_project,
+    load_baseline,
+    split_new_findings,
+    write_baseline,
+)
+from .reporters import render_json, render_text
+
+__all__ = ["check_main", "build_check_parser"]
+
+
+def build_check_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ldt check",
+        description="AST-based distributed-training lint "
+                    "(rules LDT001-LDT501; config in [tool.ldt-check])",
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to check (default: configured paths)")
+    p.add_argument("--root", default=".",
+                   help="repo root: config + baseline live here, reported "
+                        "paths are relative to it")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="write current findings to the baseline file and "
+                        "exit 0 — future runs fail only on NEW findings")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding as new")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule registry and exit")
+    return p
+
+
+def check_main(argv: Optional[Sequence[str]] = None,
+               out=None) -> int:
+    """The ``ldt check`` entry point. Returns the process exit status."""
+    args = build_check_parser().parse_args(
+        list(argv) if argv is not None else None
+    )
+    out = out if out is not None else sys.stdout
+    root = os.path.abspath(args.root)
+
+    if args.list_rules:
+        for rid, rule in sorted(all_rules().items()):
+            out.write(f"{rid}  {rule.name}: {rule.description}\n")
+        return 0
+
+    config = load_config(root)
+    if args.paths:
+        if args.update_baseline:
+            # A partial scan must never rewrite the whole baseline: findings
+            # in unscanned files would be silently un-grandfathered and the
+            # next full run would fail on them.
+            out.write(
+                "ldt check: --update-baseline requires a full scan — drop "
+                "the explicit paths\n"
+            )
+            return 2
+        config.paths = list(args.paths)
+
+    findings, modules, files_checked = analyze_project(root, config)
+    by_path = {m.relpath: m for m in modules}
+    if files_checked == 0:
+        # Scanning nothing is a misconfiguration (wrong cwd, bad --root,
+        # bad paths), not a clean result — a 0-file "pass" would silently
+        # void the gate.
+        out.write(
+            f"ldt check: no files matched {config.paths} under {root} — "
+            "run from the repo root or pass --root\n"
+        )
+        return 2
+
+    baseline_path = os.path.join(root, config.baseline)
+    if args.update_baseline:
+        write_baseline(baseline_path, findings, root, modules)
+        out.write(
+            f"ldt check: baseline written to {config.baseline} "
+            f"({len(findings)} finding{'s' if len(findings) != 1 else ''})\n"
+        )
+        return 0
+
+    if args.no_baseline:
+        new, old = list(findings), []
+    else:
+        baseline = load_baseline(baseline_path)
+        new, old = split_new_findings(findings, baseline, root, modules)
+
+    if args.as_json:
+        def line_text_of(f):
+            mod = by_path.get(f.path)
+            return mod.line_text(f.line) if mod is not None else ""
+
+        render_json(
+            new, out, root=root, grandfathered=len(old),
+            files_checked=files_checked, line_text_of=line_text_of,
+        )
+    else:
+        render_text(
+            new, out, grandfathered=len(old), files_checked=files_checked
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(check_main())

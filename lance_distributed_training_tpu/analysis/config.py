@@ -1,0 +1,87 @@
+"""``[tool.ldt-check]`` configuration.
+
+Loaded from the repo's ``pyproject.toml`` (stdlib ``tomllib`` on 3.11+,
+``tomli`` as the 3.10 fallback the container ships). Every knob has a
+default tuned to THIS repo, so ``ldt check`` with no config still gates the
+package correctly; the pyproject section exists to disable rules, exclude
+paths, and move the baseline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional
+
+__all__ = ["CheckConfig", "load_config"]
+
+
+@dataclasses.dataclass
+class CheckConfig:
+    """Knobs for the analyzer. Paths are root-relative posix."""
+
+    # What to scan.
+    paths: List[str] = dataclasses.field(
+        default_factory=lambda: ["lance_distributed_training_tpu"]
+    )
+    exclude: List[str] = dataclasses.field(default_factory=list)  # fnmatch
+    disable: List[str] = dataclasses.field(default_factory=list)  # rule ids
+    # Baseline of grandfathered findings (``ldt check --update-baseline``).
+    baseline: str = ".ldt-baseline.json"
+    # LDT401: the one module allowed to import version-moved jax symbols.
+    compat_module: str = "lance_distributed_training_tpu/parallel/_compat.py"
+    compat_symbols: List[str] = dataclasses.field(
+        default_factory=lambda: ["shard_map", "pcast", "axis_size"]
+    )
+    # LDT202: where an unbounded queue.Queue() is an error (streaming paths
+    # whose backpressure contract depends on bounded queues).
+    queue_paths: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "lance_distributed_training_tpu/service/*",
+            "lance_distributed_training_tpu/data/pipeline.py",
+            "lance_distributed_training_tpu/data/workers.py",
+        ]
+    )
+    # LDT501: the protocol-constant source of truth.
+    protocol_module: str = "lance_distributed_training_tpu/service/protocol.py"
+
+
+def _read_toml(path: str) -> Optional[dict]:
+    try:
+        import tomllib  # Python 3.11+
+    except ImportError:
+        try:
+            import tomli as tomllib  # type: ignore[no-redef]
+        except ImportError:
+            return None
+    try:
+        with open(path, "rb") as f:
+            return tomllib.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def load_config(root: str) -> CheckConfig:
+    """Defaults overlaid with ``[tool.ldt-check]`` from ``root/pyproject.toml``
+    when present and parseable; silently falls back to defaults otherwise
+    (no TOML parser must never break the gate)."""
+    config = CheckConfig()
+    data = _read_toml(os.path.join(root, "pyproject.toml"))
+    if not data:
+        return config
+    section = data.get("tool", {}).get("ldt-check", {})
+    mapping = {
+        "paths": "paths",
+        "exclude": "exclude",
+        "disable": "disable",
+        "baseline": "baseline",
+        "compat-module": "compat_module",
+        "compat-symbols": "compat_symbols",
+        "queue-paths": "queue_paths",
+        "protocol-module": "protocol_module",
+    }
+    for key, attr in mapping.items():
+        if key in section:
+            setattr(config, attr, section[key])
+    config.disable = [r.upper() for r in config.disable]
+    return config

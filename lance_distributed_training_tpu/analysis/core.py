@@ -1,0 +1,432 @@
+"""Analyzer core: module model, rule registry, suppressions, baseline.
+
+The distributed-training bug classes this subsystem gates — nondeterministic
+plan construction, host syncs inside jitted step functions, leaked workers,
+unbounded queues — all share a property: they pass every fast test and then
+silently corrupt a scaling run days later. A lint pass makes them visible at
+commit time instead. The design mirrors the pluggable-rule linters (flake8,
+ruff) at a fraction of the machinery:
+
+* :class:`ModuleInfo` — one parsed source file: AST with parent links, an
+  import-alias map (``np`` → ``numpy``), raw lines, and per-line suppression
+  state (``# ldt: ignore[LDT001]``).
+* :class:`Rule` — subclasses register with :func:`register`; a rule checks
+  either one module at a time (``check_module``) or the whole project at once
+  (``check_project`` — cross-module invariants like protocol-constant
+  consistency).
+* :class:`Finding` — one violation, with a line-content fingerprint so the
+  baseline survives line drift.
+* Baseline — grandfathered findings stored in a JSON file; ``ldt check``
+  fails only on findings NOT in the baseline, so the gate can be adopted on
+  an imperfect codebase and ratcheted down.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import hashlib
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence
+
+__all__ = [
+    "Finding",
+    "ModuleInfo",
+    "Rule",
+    "register",
+    "all_rules",
+    "analyze",
+    "analyze_project",
+    "load_baseline",
+    "write_baseline",
+    "fingerprint",
+    "split_new_findings",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a specific source location."""
+
+    rule: str  # "LDT001"
+    path: str  # root-relative posix path
+    line: int  # 1-based
+    col: int  # 0-based
+    message: str
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}"
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*ldt:\s*ignore(?:\[(?P<rules>[A-Za-z0-9_,\s]+)\])?"
+)
+
+
+def _parse_suppressions(lines: Sequence[str]) -> Dict[int, Optional[set]]:
+    """Per-line suppressions: line number → set of rule ids, or ``None``
+    meaning "suppress every rule on this line" (bare ``# ldt: ignore``)."""
+    out: Dict[int, Optional[set]] = {}
+    for i, text in enumerate(lines, start=1):
+        if "ldt:" not in text:  # cheap pre-filter
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = m.group("rules")
+        if rules is None:
+            out[i] = None
+        else:
+            out[i] = {r.strip().upper() for r in rules.split(",") if r.strip()}
+    return out
+
+
+class ModuleInfo:
+    """A parsed source file plus the derived maps every rule needs."""
+
+    def __init__(self, root: str, relpath: str, source: str):
+        self.root = root
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source, filename=relpath)
+        except SyntaxError as exc:
+            self.syntax_error = exc
+        self.suppressions = _parse_suppressions(self.lines)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.imports: Dict[str, str] = {}
+        if self.tree is not None:
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self.parents[child] = parent
+            self._collect_imports()
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def dotted_name(self) -> str:
+        """``pkg/sub/mod.py`` → ``pkg.sub.mod`` (``__init__`` → ``pkg.sub``)."""
+        mod = self.relpath[:-3] if self.relpath.endswith(".py") else self.relpath
+        parts = mod.split("/")
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    @property
+    def package(self) -> str:
+        """The package a level-1 relative import resolves against: for an
+        ``__init__.py`` that is the package itself (its dotted name), for a
+        regular module it is the parent."""
+        if self.relpath.endswith("__init__.py"):
+            return self.dotted_name
+        return (
+            self.dotted_name.rsplit(".", 1)[0]
+            if "." in self.dotted_name else ""
+        )
+
+    def _collect_imports(self) -> None:
+        """Alias → absolute dotted module/symbol map. Relative imports are
+        resolved against this module's package so cross-module rules can
+        match ``from . import protocol as P`` to the real protocol file."""
+        assert self.tree is not None
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:  # `import numpy.random as npr`
+                        self.imports[alias.asname] = alias.name
+                    else:  # `import numpy.random` binds the top name only
+                        top = alias.name.split(".")[0]
+                        self.imports[top] = top
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:  # relative: climb from this module's package
+                    pkg_parts = self.package.split(".") if self.package else []
+                    climb = node.level - 1
+                    if climb:
+                        pkg_parts = pkg_parts[: -climb or None]
+                    base = ".".join(pkg_parts + ([base] if base else []))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    self.imports[alias.asname or alias.name] = (
+                        f"{base}.{alias.name}" if base else alias.name
+                    )
+
+    # -- helpers for rules -------------------------------------------------
+
+    def qualname(self, node: ast.AST) -> Optional[str]:
+        """Dotted name of a Name/Attribute chain with the leading alias
+        resolved through the import map: ``np.random.shuffle`` →
+        ``numpy.random.shuffle``. ``None`` for non-name expressions."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        parts[0] = self.imports.get(parts[0], parts[0])
+        return ".".join(parts)
+
+    def enclosing(self, node: ast.AST, kinds) -> Optional[ast.AST]:
+        """Nearest ancestor of one of ``kinds`` (a class or tuple of AST
+        node classes), or None."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, kinds):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def statement_of(self, node: ast.AST) -> ast.AST:
+        """The innermost statement containing ``node``."""
+        cur = node
+        while not isinstance(cur, ast.stmt):
+            parent = self.parents.get(cur)
+            if parent is None:
+                return cur
+            cur = parent
+        return cur
+
+    def suppressed(self, finding: Finding) -> bool:
+        rules = self.suppressions.get(finding.line, "missing")
+        if rules == "missing":
+            return False
+        return rules is None or finding.rule in rules
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+
+class Rule:
+    """Base class. Subclass, set ``id``/``name``/``description``, implement
+    ``check_module`` and/or ``check_project``, decorate with ``@register``."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check_module(self, module: ModuleInfo, config) -> Iterable[Finding]:
+        return ()
+
+    def check_project(
+        self, modules: Sequence[ModuleInfo], config
+    ) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the global rule registry."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    # Importing the rules package populates the registry exactly once.
+    from . import rules  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+# -- analysis driver -------------------------------------------------------
+
+
+def _iter_py_files(root: str, paths: Sequence[str], exclude: Sequence[str]):
+    """Yield root-relative posix paths of .py files under ``paths``."""
+    seen = set()
+    for p in paths:
+        full = os.path.join(root, p)
+        if os.path.isfile(full) and full.endswith(".py"):
+            candidates = [p]
+        elif os.path.isdir(full):
+            candidates = []
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git")
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, fn), root
+                        )
+                        candidates.append(rel.replace(os.sep, "/"))
+        else:
+            continue
+        for rel in candidates:
+            rel = rel.replace(os.sep, "/")
+            if rel in seen:
+                continue
+            if any(fnmatch.fnmatch(rel, pat) for pat in exclude):
+                continue
+            seen.add(rel)
+            yield rel
+
+
+def analyze(root: str, config) -> List[Finding]:
+    """Parse every configured file and run every enabled rule.
+
+    Returns findings sorted by (path, line, rule), with per-line
+    ``# ldt: ignore`` suppressions already applied. Files that fail to parse
+    produce an LDT000 finding (an unparseable file cannot be checked, which
+    is itself a gate failure) and are skipped by the rules.
+    """
+    return analyze_project(root, config)[0]
+
+
+def analyze_project(root: str, config):
+    """:func:`analyze` plus the parsed modules and total file count —
+    ``(findings, modules, files_checked)``. The CLI uses the extras for
+    reporting (line text, counts) without re-reading anything."""
+    modules: List[ModuleInfo] = []
+    findings: List[Finding] = []
+    files_checked = 0
+    for rel in _iter_py_files(root, config.paths, config.exclude):
+        files_checked += 1
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as f:
+                source = f.read()
+        except OSError as exc:
+            findings.append(Finding("LDT000", rel, 1, 0, f"unreadable: {exc}"))
+            continue
+        mod = ModuleInfo(root, rel, source)
+        if mod.syntax_error is not None:
+            findings.append(
+                Finding(
+                    "LDT000", rel, mod.syntax_error.lineno or 1, 0,
+                    f"syntax error: {mod.syntax_error.msg}",
+                )
+            )
+            continue
+        modules.append(mod)
+
+    rules = {
+        rid: rule for rid, rule in all_rules().items()
+        if rid not in config.disable
+    }
+    by_path = {m.relpath: m for m in modules}
+    for rule in rules.values():
+        for mod in modules:
+            findings.extend(rule.check_module(mod, config))
+        findings.extend(rule.check_project(modules, config))
+
+    kept = []
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is not None and mod.suppressed(f):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept, modules, files_checked
+
+
+# -- baseline --------------------------------------------------------------
+
+
+def fingerprint(finding: Finding, line_text: str) -> str:
+    """Stable id for a baseline entry: rule + path + normalized line content
+    (NOT the line number, so pure line drift never un-grandfathers a
+    finding). Two identical violations on identical lines in one file
+    collapse to one fingerprint — acceptable: fixing one of them still
+    leaves the fingerprint live, and fixing both retires it."""
+    h = hashlib.sha256(
+        f"{finding.rule}|{finding.path}|{' '.join(line_text.split())}".encode()
+    )
+    return h.hexdigest()[:16]
+
+
+def _fingerprints(findings: Sequence[Finding], by_path) -> List[str]:
+    out = []
+    for f in findings:
+        mod = by_path.get(f.path)
+        text = mod.line_text(f.line) if mod is not None else ""
+        out.append(fingerprint(f, text))
+    return out
+
+
+def load_baseline(path: str) -> set:
+    """Fingerprint set from a baseline file; empty when absent."""
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {entry["fingerprint"] for entry in data.get("findings", [])}
+
+
+def write_baseline(
+    path: str,
+    findings: Sequence[Finding],
+    root: str,
+    modules: Optional[Sequence[ModuleInfo]] = None,
+) -> None:
+    """Grandfather the current findings: future runs fail only on new ones.
+    ``modules`` (from :func:`analyze_project`) supplies line text without
+    re-reading files; disk is the fallback for paths not in it."""
+    by_path = {m.relpath: m for m in (modules or ())}
+    entries = []
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is not None:
+            text = mod.line_text(f.line)
+        else:
+            try:
+                with open(os.path.join(root, f.path),
+                          encoding="utf-8") as fh:
+                    lines = fh.read().splitlines()
+                text = lines[f.line - 1] if 1 <= f.line <= len(lines) else ""
+            except OSError:
+                text = ""
+        entries.append(
+            {
+                "fingerprint": fingerprint(f, text),
+                "rule": f.rule,
+                "path": f.path,
+                "message": f.message,
+            }
+        )
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": entries}, f, indent=2)
+        f.write("\n")
+
+
+def split_new_findings(
+    findings: Sequence[Finding],
+    baseline: set,
+    root: str,
+    modules: Optional[Sequence[ModuleInfo]] = None,
+) -> tuple:
+    """(new, grandfathered) relative to a baseline fingerprint set.
+    ``modules`` (from :func:`analyze_project`) supplies line text without
+    re-reading files; disk is the fallback for paths not in it (LDT000)."""
+    new, old = [], []
+    cache: Dict[str, List[str]] = {
+        m.relpath: m.lines for m in (modules or ())
+    }
+    for f in findings:
+        if f.path not in cache:
+            try:
+                with open(os.path.join(root, f.path), encoding="utf-8") as fh:
+                    cache[f.path] = fh.read().splitlines()
+            except OSError:
+                cache[f.path] = []
+        lines = cache[f.path]
+        text = lines[f.line - 1] if 1 <= f.line <= len(lines) else ""
+        (old if fingerprint(f, text) in baseline else new).append(f)
+    return new, old

@@ -1,0 +1,81 @@
+"""Finding reporters: human text and machine ``--json``."""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence, TextIO
+
+from .core import Finding, fingerprint
+
+__all__ = ["render_text", "render_json"]
+
+
+def render_text(
+    findings: Sequence[Finding],
+    out: TextIO,
+    *,
+    grandfathered: int = 0,
+    files_checked: int = 0,
+) -> None:
+    """flake8-style one-line-per-finding in stable (path, line, rule) order,
+    followed by a summary line the gate scripts can grep."""
+    for f in findings:
+        out.write(f"{f.location()}: {f.rule} {f.message}\n")
+    if findings:
+        out.write(
+            f"\nldt check: {len(findings)} new finding"
+            f"{'s' if len(findings) != 1 else ''}"
+        )
+    else:
+        out.write("ldt check: clean")
+    if grandfathered:
+        out.write(f" ({grandfathered} baselined)")
+    out.write(f" [{files_checked} files]\n")
+
+
+def render_json(
+    findings: Sequence[Finding],
+    out: TextIO,
+    *,
+    root: str,
+    grandfathered: int = 0,
+    files_checked: int = 0,
+    line_text_of=None,
+) -> None:
+    """Machine output. Schema (stable — tests pin it)::
+
+        {
+          "version": 1,
+          "clean": bool,
+          "files_checked": int,
+          "grandfathered": int,
+          "findings": [
+            {"rule", "path", "line", "col", "message", "fingerprint"}, ...
+          ]
+        }
+    """
+    records = []
+    for f in findings:
+        text = line_text_of(f) if line_text_of is not None else ""
+        records.append(
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "message": f.message,
+                "fingerprint": fingerprint(f, text),
+            }
+        )
+    json.dump(
+        {
+            "version": 1,
+            "clean": not findings,
+            "files_checked": files_checked,
+            "grandfathered": grandfathered,
+            "findings": records,
+        },
+        out,
+        indent=2,
+    )
+    out.write("\n")

@@ -1,0 +1,76 @@
+"""Compat-enforcement rule (LDT401).
+
+The seed's single worst failure was 14 test modules dying at collection
+because ``jax.experimental.shard_map`` moved between jax releases.
+``parallel/_compat.py`` now owns every version-moved symbol (``shard_map``,
+``pcast``, ``axis_size``) behind feature-detection; this rule makes the fix
+permanent by rejecting any direct import or attribute use of those symbols
+from jax anywhere else in the package.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import Finding, ModuleInfo, Rule, register
+
+
+@register
+class DirectCompatImport(Rule):
+    id = "LDT401"
+    name = "direct-compat-import"
+    description = (
+        "version-moved jax symbol (shard_map/pcast/axis_size) imported or "
+        "used directly outside parallel/_compat.py"
+    )
+
+    def check_module(self, module: ModuleInfo, config) -> Iterable[Finding]:
+        if module.relpath == config.compat_module:
+            return
+        symbols = set(config.compat_symbols)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if node.level:  # relative import — can only be the shim
+                    continue
+                if mod == "jax" or mod.startswith("jax."):
+                    for alias in node.names:
+                        if alias.name in symbols or mod.rsplit(
+                            ".", 1
+                        )[-1] in symbols:
+                            yield Finding(
+                                self.id, module.relpath,
+                                node.lineno, node.col_offset,
+                                f"direct import of {alias.name!r} from "
+                                f"{mod!r} — this symbol moved between jax "
+                                "releases and broke package-wide import "
+                                "once already; import it from "
+                                f"{config.compat_module} instead",
+                            )
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.startswith("jax") and alias.name.rsplit(
+                        ".", 1
+                    )[-1] in symbols:
+                        yield Finding(
+                            self.id, module.relpath,
+                            node.lineno, node.col_offset,
+                            f"direct import of {alias.name!r} — import the "
+                            f"symbol from {config.compat_module} instead",
+                        )
+            elif isinstance(node, ast.Attribute):
+                if node.attr not in symbols:
+                    continue
+                qn = module.qualname(node)
+                if qn and (
+                    qn.startswith("jax.") or qn.startswith("jax.lax.")
+                ):
+                    # hasattr(lax, "...") probes are string-based and never
+                    # reach here; a real attribute use does.
+                    yield Finding(
+                        self.id, module.relpath,
+                        node.lineno, node.col_offset,
+                        f"direct use of {qn} — version-moved jax API; use "
+                        f"the shim in {config.compat_module}",
+                    )

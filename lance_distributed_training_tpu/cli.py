@@ -5,11 +5,13 @@ Flag-for-flag parity with ``/root/reference/lance_iterable.py:136-146`` (plus
 ``lance_map_style.py:128-148``, and TPU knobs). Topology comes from JAX
 process discovery, not torchrun env vars (``lance_iterable.py:154-156``).
 
-Two subcommands share the ``ldt`` entry point:
+Three subcommands share the ``ldt`` entry point:
 
 * ``ldt train …`` (or bare flags, backward-compatible) — the trainer;
 * ``ldt serve-data …`` — the disaggregated input-data service: decode on
-  CPU hosts, trainers point at it with ``--data_service host:port``.
+  CPU hosts, trainers point at it with ``--data_service host:port``;
+* ``ldt check …`` — the AST-based distributed-training lint (exits
+  non-zero on new findings; see README "Static analysis").
 
 Usage::
 
@@ -200,6 +202,10 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "this host's cores)")
     p.add_argument("--queue_depth", type=int, default=4,
                    help="bounded per-client batch queue (backpressure)")
+    p.add_argument("--handshake_timeout_s", type=float, default=30.0,
+                   help="per-connection HELLO deadline; a peer that "
+                        "connects and stays silent is dropped after this "
+                        "(0 = wait forever)")
     p.add_argument("--read_retries", type=int, default=3,
                    help="dataset-read attempts (exponential backoff) before "
                         "erroring a client stream")
@@ -221,6 +227,7 @@ def serve_main(argv=None) -> dict:
         image_size=args.image_size,
         num_workers=args.num_workers,
         queue_depth=args.queue_depth,
+        handshake_timeout_s=args.handshake_timeout_s,
         read_retries=args.read_retries,
         log_every_s=args.log_every_s,
     ))
@@ -233,9 +240,11 @@ def console_entry() -> int:
     returns the final metrics dict for programmatic callers; a setuptools
     script wraps its return in ``sys.exit(...)``, which would turn every
     successful run into exit status 1 with the dict dumped to stderr —
-    so the script target is this wrapper, which discards the dict."""
-    main()
-    return 0
+    so the script target is this wrapper, which discards the dict. The
+    ``check`` subcommand instead returns an int exit status (its non-zero
+    exit IS the lint gate), which passes through."""
+    result = main()
+    return result if isinstance(result, int) else 0
 
 
 def main(argv=None) -> dict:
@@ -248,6 +257,12 @@ def main(argv=None) -> dict:
     # (every existing invocation keeps working).
     if argv and argv[0] == "serve-data":
         return serve_main(argv[1:])
+    if argv and argv[0] == "check":
+        # The static-analysis gate: returns an int exit status (0 = clean /
+        # no new findings), not a metrics dict.
+        from .analysis.cli import check_main
+
+        return check_main(argv[1:])
     if argv and argv[0] == "train":
         argv = argv[1:]
     args = build_parser().parse_args(argv)
@@ -369,4 +384,6 @@ def main(argv=None) -> dict:
 
 
 if __name__ == "__main__":
-    main()
+    _result = main()
+    if isinstance(_result, int) and _result != 0:
+        raise SystemExit(_result)

@@ -16,6 +16,14 @@ consumer turns each host batch into a **global** ``jax.Array`` sharded
 ``P('data')`` over the mesh (``make_global_batch``), so the H2D DMA for step
 N+1 overlaps the device compute of step N. That overlap — not a faster
 kernel — is what drives loader-stall below the 2% BASELINE target.
+
+Thread & queue policy (enforced by ``ldt check`` LDT201/LDT202): producer
+threads are ``daemon=True`` (a wedged decode must never block interpreter
+exit — a plain ThreadPoolExecutor would, via its atexit join), queues are
+always bounded (``prefetch``, clamped >= 1) so decode can't run away from a
+slow consumer, and teardown uses drain-then-join: pop until the producer's
+blocked ``put()`` can observe the stop flag, then ``join`` with a timeout.
+``service/server.py`` and ``service/client.py`` follow the same discipline.
 """
 
 from __future__ import annotations

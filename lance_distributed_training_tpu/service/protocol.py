@@ -32,6 +32,7 @@ from __future__ import annotations
 import json
 import socket
 import struct
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -76,12 +77,25 @@ class ProtocolError(RuntimeError):
     """Framing/handshake violation — the connection is unusable."""
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytearray:
-    """Read exactly ``n`` bytes or raise ``ConnectionError`` on EOF."""
+def _recv_exact(
+    sock: socket.socket, n: int, deadline: Optional[float] = None
+) -> bytearray:
+    """Read exactly ``n`` bytes or raise ``ConnectionError`` on EOF.
+
+    ``deadline`` (a ``time.monotonic()`` instant) bounds the WHOLE read, not
+    each ``recv`` — a socket-level ``settimeout`` alone resets per received
+    byte, so a peer dripping one byte per interval could hold a handshake
+    open forever. The streaming hot path passes no deadline and keeps the
+    zero-overhead single-recv loop."""
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
     while got < n:
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout("frame-read deadline exceeded")
+            sock.settimeout(remaining)
         r = sock.recv_into(view[got:], n - got)
         if r == 0:
             raise ConnectionError("peer closed mid-frame")
@@ -103,12 +117,14 @@ def send_frame(sock: socket.socket, msg_type: int, payload: bytes) -> None:
         sock.sendall(header + payload)
 
 
-def recv_frame(sock: socket.socket) -> Tuple[int, bytearray]:
-    header = _recv_exact(sock, _HEADER.size)
+def recv_frame(
+    sock: socket.socket, deadline: Optional[float] = None
+) -> Tuple[int, bytearray]:
+    header = _recv_exact(sock, _HEADER.size, deadline)
     length, msg_type = _HEADER.unpack(header)
     if length >= MAX_FRAME:
         raise ProtocolError(f"frame too large: {length} bytes")
-    return msg_type, _recv_exact(sock, length)
+    return msg_type, _recv_exact(sock, length, deadline)
 
 
 def send_msg(sock: socket.socket, msg_type: int, payload: dict) -> None:
@@ -117,10 +133,14 @@ def send_msg(sock: socket.socket, msg_type: int, payload: dict) -> None:
     send_frame(sock, msg_type, json.dumps(payload).encode("utf-8"))
 
 
-def recv_msg(sock: socket.socket) -> Tuple[int, dict]:
+def recv_msg(
+    sock: socket.socket, deadline: Optional[float] = None
+) -> Tuple[int, dict]:
     """Receive any frame; control payloads are JSON-decoded, batch frames
-    are returned raw under ``{"raw": bytearray}`` for :func:`decode_batch`."""
-    msg_type, payload = recv_frame(sock)
+    are returned raw under ``{"raw": bytearray}`` for :func:`decode_batch`.
+    ``deadline`` bounds the whole receive (see :func:`_recv_exact`) — used
+    for handshake frames, never for the streaming phase."""
+    msg_type, payload = recv_frame(sock, deadline)
     if msg_type == MSG_BATCH:
         return msg_type, {"raw": payload}
     try:

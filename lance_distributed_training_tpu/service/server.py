@@ -25,6 +25,17 @@ Robustness model (the r04/r05 outage history is the motivation):
 
 Run it with ``ldt serve-data --dataset_path … --port …`` on CPU hosts and
 point trainers at it with ``--data_service host:port``.
+
+Thread & queue policy (enforced by ``ldt check`` LDT201/LDT202/LDT203):
+every thread is ``daemon=True`` — a hung decode or a dead peer must never
+block interpreter exit — and every thread that can block on a bounded-queue
+``put()`` is torn down by the drain-then-join pattern (pop until the stop
+flag is observable, then ``join`` with a timeout). Per-client queues are
+always bounded (``queue_depth``, clamped ≥ 1), which is what makes
+backpressure propagate from a slow trainer back into decode instead of
+buffering the remaining epoch server-side. Handshake receives carry a
+deadline (``handshake_timeout_s``); streaming receives deliberately do not —
+an idle-but-alive peer is normal mid-epoch, and close() unblocks them.
 """
 
 from __future__ import annotations
@@ -57,6 +68,7 @@ class ServeConfig:
     image_size: int = 224
     num_workers: int = 0  # >0: decode in N spawned worker processes
     queue_depth: int = 4  # per-client bounded batch queue
+    handshake_timeout_s: float = 30.0  # HELLO recv deadline per connection
     read_retries: int = 3  # dataset-read attempts before ERROR
     retry_backoff_s: float = 0.05  # doubles per attempt
     log_every_s: float = 0.0  # >0: periodic stats line to stdout
@@ -87,7 +99,17 @@ class _ClientSession:
         """Handler-thread entry: handshake, then stream the plan."""
         svc = self.service
         try:
-            msg_type, req = P.recv_msg(self.sock)
+            # Handshake deadline: a peer that connects and never sends a
+            # complete HELLO (port scanner, wedged client, byte-dripping
+            # half-open connection) must not pin this handler thread
+            # forever. The deadline bounds the WHOLE frame read (recv_msg
+            # shrinks the socket timeout between chunks), then is cleared —
+            # streaming recv (ACKs) has different semantics: an
+            # idle-but-alive trainer is normal there (ldt check LDT203).
+            timeout = svc.config.handshake_timeout_s
+            deadline = time.monotonic() + timeout if timeout > 0 else None
+            msg_type, req = P.recv_msg(self.sock, deadline=deadline)
+            self.sock.settimeout(None)  # clear what _recv_exact left set
             if msg_type != P.MSG_HELLO:
                 raise P.ProtocolError(
                     f"expected HELLO, got message type {msg_type}"

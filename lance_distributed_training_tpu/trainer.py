@@ -1036,7 +1036,7 @@ def train(config: TrainConfig) -> dict:
             worker_pool.shutdown()
         if ckpt is not None:
             ckpt.close()
-        logger.finish()
+        logger.close()
 
 
 def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,

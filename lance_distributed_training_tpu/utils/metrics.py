@@ -132,12 +132,25 @@ class MetricLogger:
         )
         print(f"[metrics] {pretty}", flush=True)
 
-    def finish(self) -> None:
+    def close(self) -> None:
+        """Release every sink: finish the wandb run, close the JSONL file.
+        Idempotent; the trainer calls it from its shutdown ``finally`` and
+        ``with MetricLogger(...) as logger`` works for programmatic use."""
         if self._wandb is not None:
             self._wandb.finish()
+            self._wandb = None
         if self._jsonl is not None:
             self._jsonl.close()
             self._jsonl = None
+
+    # Historical spelling (wandb's verb); close() is the canonical teardown.
+    finish = close
+
+    def __enter__(self) -> "MetricLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class StepTimer:

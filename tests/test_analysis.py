@@ -1,0 +1,684 @@
+"""``ldt check`` analyzer tests: per-rule true-positive/true-negative
+fixtures, suppression comments, baseline behavior, JSON schema, CLI
+dispatch, and the self-check that the repo itself is clean."""
+
+import io
+import json
+import os
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from lance_distributed_training_tpu.analysis import (
+    CheckConfig,
+    analyze,
+    all_rules,
+    check_main,
+)
+
+pytestmark = pytest.mark.fast
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def run_rules(tmp_path, files, **config_kwargs):
+    """Write fixture ``files`` ({relpath: source}) under tmp_path and run
+    the analyzer over them. Returns the finding list."""
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    config_kwargs.setdefault("paths", ["."])
+    config_kwargs.setdefault("queue_paths", ["*"])
+    config = CheckConfig(**config_kwargs)
+    return analyze(str(tmp_path), config)
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# -- LDT000 ----------------------------------------------------------------
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    findings = run_rules(tmp_path, {"bad.py": "def broken(:\n"})
+    assert rule_ids(findings) == ["LDT000"]
+
+
+# -- LDT001 unseeded global RNG --------------------------------------------
+
+
+def test_ldt001_flags_np_global_state(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import numpy as np
+        order = np.random.permutation(100)
+    """})
+    assert rule_ids(findings) == ["LDT001"]
+    assert "default_rng" in findings[0].message
+
+
+def test_ldt001_flags_stdlib_random(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import random
+        random.shuffle([1, 2, 3])
+    """})
+    assert rule_ids(findings) == ["LDT001"]
+
+
+def test_ldt001_accepts_seeded_generator(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import numpy as np
+        rng = np.random.default_rng(7)
+        order = rng.permutation(100)
+    """})
+    assert findings == []
+
+
+# -- LDT002 wall-clock seed ------------------------------------------------
+
+
+def test_ldt002_flags_time_assigned_to_seed(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import time
+        seed = int(time.time())
+    """})
+    assert rule_ids(findings) == ["LDT002"]
+
+
+def test_ldt002_flags_time_as_seed_keyword(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import time
+
+        def build(make_plan):
+            return make_plan(8, seed=time.time_ns())
+    """})
+    assert rule_ids(findings) == ["LDT002"]
+
+
+def test_ldt002_accepts_timing_use(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import time
+        t0 = time.time()
+        elapsed = time.time() - t0
+    """})
+    assert findings == []
+
+
+# -- LDT003 unsorted fs listing --------------------------------------------
+
+
+def test_ldt003_flags_bare_listdir(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import os
+
+        def samples(root):
+            out = []
+            for name in os.listdir(root):
+                out.append(name)
+            return out
+    """})
+    assert rule_ids(findings) == ["LDT003"]
+
+
+def test_ldt003_accepts_sorted_and_orderless_uses(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import os
+
+        def classes(root):
+            names = sorted(d for d in os.listdir(root))
+            direct = sorted(os.listdir(root))
+            count = len(os.listdir(root))
+            present = "x" in os.listdir(root)
+            later = os.listdir(root)
+            later.sort()
+            return names, direct, count, present, later
+    """})
+    assert findings == []
+
+
+# -- LDT101 / LDT102 jit purity --------------------------------------------
+
+
+def test_ldt101_flags_print_in_decorated_jit(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import jax
+
+        @jax.jit
+        def step(x):
+            print("loss", x)
+            return x * 2
+    """})
+    assert rule_ids(findings) == ["LDT101"]
+
+
+def test_ldt101_flags_wrapped_function_by_name(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import jax
+        import logging
+
+        def step(x):
+            logging.info("tracing %s", x)
+            return x
+
+        fast_step = jax.jit(step, donate_argnums=(0,))
+    """})
+    assert rule_ids(findings) == ["LDT101"]
+
+
+def test_ldt102_flags_host_syncs_in_jit(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(1,))
+        def step(x, n):
+            scale = float(x)
+            return x.item() + scale
+    """})
+    assert sorted(rule_ids(findings)) == ["LDT102", "LDT102"]
+
+
+def test_ldt101_log_named_math_variable_is_not_telemetry(tmp_path):
+    # `log = jnp.log(p); log.sum()` is math — only logging VERBS on a
+    # logger-named variable count as side effects.
+    findings = run_rules(tmp_path, {"m.py": """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(p, y):
+            log = jnp.log(p)
+            return -(log * y).sum()
+
+        @jax.jit
+        def bad(logger, x):
+            logger.info("x=%s", x)
+            return x
+    """})
+    assert rule_ids(findings) == ["LDT101"]
+    assert "logger.info" in findings[0].message
+
+
+def test_jit_purity_accepts_clean_step_and_outside_effects(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(state, batch):
+            loss = jnp.mean(batch)
+            return state, loss
+
+        def outer(batch):
+            loss = step(None, batch)[1]
+            print("loss", float(loss))  # outside jit: fine
+            return loss.item()
+    """})
+    assert findings == []
+
+
+# -- LDT201 thread lifecycle -----------------------------------------------
+
+
+def test_ldt201_flags_thread_without_policy(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import threading
+
+        def go(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+    """})
+    assert rule_ids(findings) == ["LDT201"]
+
+
+def test_ldt201_accepts_daemon_or_join(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import threading
+
+        def daemonized(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+
+        def joined(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+    """})
+    assert findings == []
+
+
+# -- LDT202 unbounded queue ------------------------------------------------
+
+
+def test_ldt202_flags_unbounded_queue_on_stream_path(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import queue
+        q = queue.Queue()
+    """})
+    assert rule_ids(findings) == ["LDT202"]
+
+
+def test_ldt202_flags_maxsize_zero_as_unbounded(tmp_path):
+    # Stdlib semantics: maxsize<=0 means INFINITE — the explicit-default
+    # spelling must not slip past the gate.
+    findings = run_rules(tmp_path, {"m.py": """\
+        import queue
+        a = queue.Queue(maxsize=0)
+        b = queue.Queue(0)
+        c = queue.Queue(-1)
+    """})
+    assert rule_ids(findings) == ["LDT202", "LDT202", "LDT202"]
+
+
+def test_ldt202_accepts_bounded_and_out_of_scope(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {
+            "svc/stream.py": "import queue\nq = queue.Queue(maxsize=4)\n",
+            "tools/misc.py": "import queue\nq = queue.Queue()\n",
+        },
+        queue_paths=["svc/*"],
+    )
+    assert findings == []
+
+
+# -- LDT203 handshake recv timeout ------------------------------------------
+
+
+def test_ldt203_flags_handshake_recv_without_deadline(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        def do_handshake(sock):
+            hello = sock.recv(64)
+            return hello
+    """})
+    assert rule_ids(findings) == ["LDT203"]
+
+
+def test_ldt203_accepts_deadline_before_recv(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        def do_handshake(sock):
+            sock.settimeout(30.0)
+            hello = sock.recv(64)
+            sock.settimeout(None)
+            return hello
+
+        def stream_loop(sock):
+            # steady-state receive: not handshake-shaped, no deadline needed
+            return sock.recv(64)
+    """})
+    assert findings == []
+
+
+def test_ldt203_accepts_deadline_kwarg(tmp_path):
+    # recv_msg(sock, deadline=...) bounds the whole frame read — stronger
+    # than settimeout; deadline=None does not count.
+    findings = run_rules(tmp_path, {"m.py": """\
+        def handshake_ok(sock, recv_msg, now):
+            return recv_msg(sock, deadline=now() + 30.0)
+
+        def handshake_bad(sock, recv_msg):
+            return recv_msg(sock, deadline=None)
+    """})
+    assert rule_ids(findings) == ["LDT203"]
+    assert findings[0].line == 5
+
+
+# -- LDT301 resource ownership ----------------------------------------------
+
+
+def test_ldt301_flags_self_store_without_teardown(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        class Logger:
+            def __init__(self, path):
+                self._f = open(path, "a")
+
+            def log(self, line):
+                self._f.write(line)
+    """})
+    assert rule_ids(findings) == ["LDT301"]
+    assert "Logger" in findings[0].message
+
+
+def test_ldt301_flags_discarded_and_never_closed(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import socket
+
+        def probe(path, addr):
+            open(path)
+            s = socket.socket()
+            s.connect(addr)
+    """})
+    assert sorted(rule_ids(findings)) == ["LDT301", "LDT301"]
+
+
+def test_ldt301_accepts_ownership_stories(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import socket
+
+        class Service:
+            def __init__(self, path):
+                self._f = open(path, "a")
+
+            def close(self):
+                self._f.close()
+
+        def read(path):
+            with open(path) as f:
+                return f.read()
+
+        def dial(addr):
+            s = socket.socket()
+            try:
+                s.connect(addr)
+                return s
+            except OSError:
+                s.close()
+                raise
+
+        def handoff(addr, register):
+            s = socket.socket()
+            register(s)
+    """})
+    assert findings == []
+
+
+# -- LDT401 compat enforcement ----------------------------------------------
+
+
+def test_ldt401_flags_direct_imports_outside_shim(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {
+            "pkg/ring.py": """\
+                from jax.experimental.shard_map import shard_map
+                from jax import lax
+
+                def size(name):
+                    return lax.axis_size(name)
+            """,
+            "pkg/_compat.py": """\
+                from jax import lax
+                pcast = getattr(lax, "pcast", None)
+            """,
+        },
+        compat_module="pkg/_compat.py",
+    )
+    assert sorted(rule_ids(findings)) == ["LDT401", "LDT401"]
+    assert all(f.path == "pkg/ring.py" for f in findings)
+
+
+def test_ldt401_accepts_shim_import(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {
+            "pkg/ring.py": "from ._compat import shard_map, pcast\n",
+            "pkg/_compat.py": "shard_map = pcast = None\n",
+        },
+        compat_module="pkg/_compat.py",
+    )
+    assert findings == []
+
+
+# -- LDT501 protocol consistency --------------------------------------------
+
+
+def test_ldt501_flags_missing_and_mismatched_constants(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {
+            "svc/__init__.py": "",
+            "svc/protocol.py": "PROTOCOL_VERSION = 1\nMSG_HELLO = 1\n",
+            "svc/client.py": """\
+                from . import protocol as P
+
+                MSG_HELLO = 2
+
+                def hello():
+                    return P.MSG_HELLO_OK, P.PROTOCOL_VERSION
+            """,
+        },
+        protocol_module="svc/protocol.py",
+    )
+    assert sorted(rule_ids(findings)) == ["LDT501", "LDT501"]
+    messages = " | ".join(f.message for f in findings)
+    assert "MSG_HELLO_OK" in messages  # referenced but undefined
+    assert "redefined" in messages  # MSG_HELLO = 2 vs 1
+
+
+def test_ldt501_checks_package_init_imports(tmp_path):
+    # Relative imports in an __init__.py resolve against the package
+    # itself, not its parent — a missing constant re-exported from
+    # svc/__init__.py must be caught.
+    findings = run_rules(
+        tmp_path,
+        {
+            "svc/__init__.py": "from .protocol import MSG_GONE\n",
+            "svc/protocol.py": "MSG_HELLO = 1\n",
+        },
+        protocol_module="svc/protocol.py",
+    )
+    assert rule_ids(findings) == ["LDT501"]
+    assert "MSG_GONE" in findings[0].message
+
+
+def test_ldt501_sees_annotated_constants(tmp_path):
+    # `MSG_FOO: int = 7` (AnnAssign) must count as defined — and a
+    # mismatched annotated redefinition must still be caught.
+    findings = run_rules(
+        tmp_path,
+        {
+            "svc/__init__.py": "",
+            "svc/protocol.py": "MSG_FOO: int = 7\n",
+            "svc/client.py": """\
+                from . import protocol as P
+
+                MSG_FOO: int = 8
+
+                def use():
+                    return P.MSG_FOO
+            """,
+        },
+        protocol_module="svc/protocol.py",
+    )
+    assert rule_ids(findings) == ["LDT501"]
+    assert "redefined" in findings[0].message
+
+
+def test_ldt501_accepts_consistent_references(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {
+            "svc/__init__.py": "",
+            "svc/protocol.py": "PROTOCOL_VERSION = 1\nMSG_HELLO = 1\n",
+            "svc/client.py": """\
+                from . import protocol as P
+
+                def hello():
+                    return P.MSG_HELLO, P.PROTOCOL_VERSION
+            """,
+        },
+        protocol_module="svc/protocol.py",
+    )
+    assert findings == []
+
+
+def test_real_protocol_constants_all_resolve():
+    # The live client/server must only reference constants protocol.py
+    # defines — the exact invariant LDT501 encodes, asserted directly
+    # against the real modules as a belt-and-braces check.
+    import lance_distributed_training_tpu.service.protocol as P
+
+    for name in ("MSG_HELLO", "MSG_HELLO_OK", "MSG_BATCH", "MSG_ACK",
+                 "MSG_END", "MSG_ERROR", "PROTOCOL_VERSION"):
+        assert hasattr(P, name)
+
+
+# -- suppressions ------------------------------------------------------------
+
+
+def test_suppression_comment_silences_matching_rule(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import numpy as np
+        a = np.random.permutation(10)  # ldt: ignore[LDT001]
+        b = np.random.permutation(10)  # ldt: ignore
+        c = np.random.permutation(10)  # ldt: ignore[LDT999]
+        d = np.random.permutation(10)
+    """})
+    assert [f.line for f in findings] == [4, 5]  # c (wrong id) and d
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+VIOLATION = "import numpy as np\nx = np.random.permutation(4)\n"
+
+
+def _write_pkg(tmp_path, source=VIOLATION):
+    (tmp_path / "m.py").write_text(source)
+
+
+def test_baseline_grandfathers_then_catches_new(tmp_path):
+    pytest.importorskip("tomli")
+    # Baseline updates require the configured full scan (not positional
+    # paths), so configure the fixture root via pyproject.
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.ldt-check]\npaths = ["."]\n'
+    )
+    _write_pkg(tmp_path)
+    root = str(tmp_path)
+    out = io.StringIO()
+    assert check_main(["--root", root], out=out) == 1  # dirty, no baseline
+
+    assert check_main(["--root", root, "--update-baseline"], out=out) == 0
+    assert (tmp_path / ".ldt-baseline.json").exists()
+    assert check_main(["--root", root], out=out) == 0  # grandfathered
+
+    # Line drift must not un-grandfather: shift the violation down.
+    _write_pkg(tmp_path, "# a leading comment\n" + VIOLATION)
+    assert check_main(["--root", root, "."], out=out) == 0
+
+    # A NEW violation still fails, and only the new one is reported.
+    _write_pkg(tmp_path, VIOLATION + "import random\nrandom.shuffle([1])\n")
+    out = io.StringIO()
+    assert check_main(["--root", root, "."], out=out) == 1
+    assert "LDT001" in out.getvalue()
+    text = out.getvalue()
+    assert "1 new finding" in text and "1 baselined" in text
+
+    # --no-baseline reports everything.
+    out = io.StringIO()
+    assert check_main(["--root", root, ".", "--no-baseline"], out=out) == 1
+    assert "2 new findings" in out.getvalue()
+
+
+def test_update_baseline_refuses_partial_scan(tmp_path):
+    _write_pkg(tmp_path)
+    out = io.StringIO()
+    rc = check_main(
+        ["--root", str(tmp_path), ".", "--update-baseline"], out=out
+    )
+    assert rc == 2
+    assert "full scan" in out.getvalue()
+
+
+def test_zero_files_scanned_is_an_error_not_a_pass(tmp_path):
+    # Wrong cwd / bad --root must not produce a silent "clean" gate pass.
+    out = io.StringIO()
+    rc = check_main(["--root", str(tmp_path), "no/such/dir"], out=out)
+    assert rc == 2
+    assert "no files matched" in out.getvalue()
+
+
+# -- JSON reporter -----------------------------------------------------------
+
+
+def test_json_output_schema(tmp_path):
+    _write_pkg(tmp_path)
+    out = io.StringIO()
+    rc = check_main(["--root", str(tmp_path), ".", "--json"], out=out)
+    assert rc == 1
+    data = json.loads(out.getvalue())
+    assert data["version"] == 1
+    assert data["clean"] is False
+    assert isinstance(data["files_checked"], int)
+    assert isinstance(data["grandfathered"], int)
+    (finding,) = data["findings"]
+    assert set(finding) == {
+        "rule", "path", "line", "col", "message", "fingerprint"
+    }
+    assert finding["rule"] == "LDT001"
+    assert finding["path"] == "m.py"
+    assert finding["line"] == 2
+    assert isinstance(finding["fingerprint"], str) and finding["fingerprint"]
+
+
+def test_json_clean_output(tmp_path):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    out = io.StringIO()
+    rc = check_main(["--root", str(tmp_path), ".", "--json"], out=out)
+    assert rc == 0
+    data = json.loads(out.getvalue())
+    assert data["clean"] is True and data["findings"] == []
+
+
+# -- config ------------------------------------------------------------------
+
+
+def test_pyproject_config_section(tmp_path):
+    pytest.importorskip("tomli")
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""\
+        [tool.ldt-check]
+        paths = ["pkg"]
+        disable = ["ldt001"]
+        baseline = "custom-baseline.json"
+    """))
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "m.py").write_text(VIOLATION)
+    (tmp_path / "outside.py").write_text(VIOLATION)
+    out = io.StringIO()
+    # LDT001 disabled + paths limited to pkg/ => clean.
+    assert check_main(["--root", str(tmp_path)], out=out) == 0
+
+    from lance_distributed_training_tpu.analysis import load_config
+
+    config = load_config(str(tmp_path))
+    assert config.paths == ["pkg"]
+    assert config.disable == ["LDT001"]
+    assert config.baseline == "custom-baseline.json"
+
+
+# -- CLI dispatch ------------------------------------------------------------
+
+
+def test_ldt_check_subcommand_dispatch(tmp_path):
+    import lance_distributed_training_tpu.cli as cli
+
+    _write_pkg(tmp_path)
+    rc = cli.main(["check", "--root", str(tmp_path), ".", "--no-baseline"])
+    assert rc == 1
+
+    (tmp_path / "m.py").write_text("x = 1\n")
+    rc = cli.main(["check", "--root", str(tmp_path), "."])
+    assert rc == 0
+
+
+def test_list_rules_covers_registry(capsys):
+    assert check_main(["--list-rules"]) == 0
+    listed = capsys.readouterr().out
+    for rid in all_rules():
+        assert rid in listed
+    assert len(all_rules()) >= 8
+
+
+# -- self-check ---------------------------------------------------------------
+
+
+def test_repo_is_clean_under_ldt_check():
+    """The permanent gate: the repo's own package must pass its own lint.
+    If this fails, either fix the finding or (deliberately, reviewed)
+    suppress/baseline it."""
+    out = io.StringIO()
+    rc = check_main(["--root", str(REPO_ROOT)], out=out)
+    assert rc == 0, f"ldt check found new violations:\n{out.getvalue()}"

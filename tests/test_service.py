@@ -257,6 +257,62 @@ def test_client_drop_with_empty_queue_frees_session(image_dataset, service):
     assert len(list(_loader(service))) == 240 // 16
 
 
+def test_recv_deadline_bounds_whole_frame_not_each_byte():
+    """A byte-dripping peer must not extend the handshake window: the
+    deadline bounds the entire frame read, while each individual recv
+    would otherwise reset a plain settimeout."""
+    import time as _time
+
+    a, b = socket.socketpair()
+    try:
+        # A valid header promising 8 payload bytes, then... one byte only.
+        a.sendall(P._HEADER.pack(8, P.MSG_HELLO))
+        a.sendall(b"x")
+        t0 = _time.monotonic()
+        with pytest.raises((socket.timeout, TimeoutError)):
+            P.recv_msg(b, deadline=_time.monotonic() + 0.3)
+        assert _time.monotonic() - t0 < 5.0  # bounded, not pinned
+    finally:
+        a.close()
+        b.close()
+
+
+def test_silent_peer_dropped_after_handshake_timeout(image_dataset):
+    """A peer that connects and never sends HELLO (scanner, wedged client)
+    must be dropped at handshake_timeout_s instead of pinning its handler
+    thread forever (the ldt check LDT203 invariant, exercised live)."""
+    import time as _time
+
+    svc = DataService(ServeConfig(
+        dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+        image_size=32, handshake_timeout_s=0.3,
+    )).start()
+    try:
+        silent = socket.create_connection(("127.0.0.1", svc.port))
+        try:
+            # The session must first register (accept happened)...
+            deadline = _time.monotonic() + 10
+            while _time.monotonic() < deadline:
+                with svc._sessions_lock:
+                    if svc._sessions:
+                        break
+                _time.sleep(0.01)
+            # ...then be reaped when the HELLO deadline expires.
+            while _time.monotonic() < deadline:
+                with svc._sessions_lock:
+                    if not svc._sessions:
+                        break
+                _time.sleep(0.05)
+            with svc._sessions_lock:
+                assert not svc._sessions  # reaped by the deadline
+            # The server stayed healthy for a real client afterwards.
+            assert len(list(_loader(svc))) == 240 // 16
+        finally:
+            silent.close()
+    finally:
+        svc.stop()
+
+
 def test_bad_shard_rejected(image_dataset, service):
     loader = RemoteLoader(
         f"127.0.0.1:{service.port}", 16, 3, 2,  # process 3 of 2
