@@ -30,7 +30,7 @@ def build_check_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ldt check",
         description="AST-based distributed-training lint "
-                    "(rules LDT001-LDT501; config in [tool.ldt-check])",
+                    "(rules LDT001-LDT601; config in [tool.ldt-check])",
     )
     p.add_argument("paths", nargs="*", default=None,
                    help="files/dirs to check (default: configured paths)")

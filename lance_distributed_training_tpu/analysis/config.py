@@ -44,6 +44,18 @@ class CheckConfig:
     )
     # LDT501: the protocol-constant source of truth.
     protocol_module: str = "lance_distributed_training_tpu/service/protocol.py"
+    # LDT601: the instrumented modules (telemetry clock + metric-name
+    # hygiene) — no time.time(); metric names must be Prometheus-safe.
+    obs_paths: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "lance_distributed_training_tpu/obs/*",
+            "lance_distributed_training_tpu/utils/metrics.py",
+            "lance_distributed_training_tpu/utils/profiling.py",
+            "lance_distributed_training_tpu/service/*",
+            "lance_distributed_training_tpu/data/pipeline.py",
+            "lance_distributed_training_tpu/data/workers.py",
+        ]
+    )
 
 
 def _read_toml(path: str) -> Optional[dict]:
@@ -79,6 +91,7 @@ def load_config(root: str) -> CheckConfig:
         "compat-symbols": "compat_symbols",
         "queue-paths": "queue_paths",
         "protocol-module": "protocol_module",
+        "obs-paths": "obs_paths",
     }
     for key, attr in mapping.items():
         if key in section:

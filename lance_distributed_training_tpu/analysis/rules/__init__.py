@@ -12,6 +12,7 @@ from . import (  # noqa: F401  (import for registration side effect)
     concurrency,
     determinism,
     jit_purity,
+    obs,
     protocol,
     resources,
 )

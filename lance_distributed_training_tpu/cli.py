@@ -5,13 +5,15 @@ Flag-for-flag parity with ``/root/reference/lance_iterable.py:136-146`` (plus
 ``lance_map_style.py:128-148``, and TPU knobs). Topology comes from JAX
 process discovery, not torchrun env vars (``lance_iterable.py:154-156``).
 
-Three subcommands share the ``ldt`` entry point:
+Four subcommands share the ``ldt`` entry point:
 
 * ``ldt train …`` (or bare flags, backward-compatible) — the trainer;
 * ``ldt serve-data …`` — the disaggregated input-data service: decode on
   CPU hosts, trainers point at it with ``--data_service host:port``;
 * ``ldt check …`` — the AST-based distributed-training lint (exits
-  non-zero on new findings; see README "Static analysis").
+  non-zero on new findings; see README "Static analysis");
+* ``ldt trace export …`` — convert recorded span JSONL (LDT_TRACE_PATH)
+  into a Perfetto-loadable Chrome trace (see README "Telemetry").
 
 Usage::
 
@@ -126,6 +128,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval_every", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--run_name", type=str, default=None)
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="process 0 serves /metrics (Prometheus text) and "
+                        "/healthz on this port for the run's lifetime "
+                        "(trainer_* histograms, svc_*/lineage_* when "
+                        "streaming from a data service); 0 = ephemeral, "
+                        "logged at startup (same contract as serve-data; "
+                        "default off)")
+    p.add_argument("--metrics_host", type=str, default="127.0.0.1",
+                   help="exporter bind address (default loopback; the "
+                        "endpoint is unauthenticated — 0.0.0.0 is an "
+                        "explicit opt-in)")
     p.add_argument("--log_every", type=int, default=50,
                    help="per-step progress line every N steps (0 = off)")
     p.add_argument("--log_grad_norm", action="store_true",
@@ -211,6 +224,15 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "erroring a client stream")
     p.add_argument("--log_every_s", type=float, default=30.0,
                    help="periodic service-stats line; 0 = off")
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="serve /metrics (Prometheus text: svc_* counters, "
+                        "decode/queue-wait histograms) and /healthz (queue "
+                        "depths, client liveness) on this port "
+                        "(0 = ephemeral, printed at startup; default off)")
+    p.add_argument("--metrics_host", type=str, default="127.0.0.1",
+                   help="exporter bind address (default loopback; the "
+                        "endpoint is unauthenticated — 0.0.0.0 is an "
+                        "explicit opt-in)")
     return p
 
 
@@ -230,6 +252,8 @@ def serve_main(argv=None) -> dict:
         handshake_timeout_s=args.handshake_timeout_s,
         read_retries=args.read_retries,
         log_every_s=args.log_every_s,
+        metrics_port=args.metrics_port,
+        metrics_host=args.metrics_host,
     ))
     service.serve_forever()
     return service.counters.snapshot()
@@ -263,6 +287,12 @@ def main(argv=None) -> dict:
         from .analysis.cli import check_main
 
         return check_main(argv[1:])
+    if argv and argv[0] == "trace":
+        # Telemetry export: span JSONL (LDT_TRACE_PATH) → Chrome-trace JSON
+        # loadable in Perfetto. Returns an int exit status.
+        from .obs.spans import trace_main
+
+        return trace_main(argv[1:])
     if argv and argv[0] == "train":
         argv = argv[1:]
     args = build_parser().parse_args(argv)
@@ -362,6 +392,8 @@ def main(argv=None) -> dict:
         eval_every=args.eval_every,
         seed=args.seed,
         run_name=args.run_name,
+        metrics_port=args.metrics_port,
+        metrics_host=args.metrics_host,
         log_every=args.log_every,
         log_grad_norm=args.log_grad_norm,
         model_parallelism=args.model_parallelism,

@@ -30,12 +30,16 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from functools import partial
 from typing import Callable, Iterator, Optional, Sequence
 
 import numpy as np
 import pyarrow as pa
 
+from ..obs.lineage import make_lineage, observe_local_lineage
+from ..obs.registry import default_registry
+from ..obs.spans import span
 from .format import Dataset
 from .samplers import (
     Plan,
@@ -125,6 +129,10 @@ class DataPipeline:
         self.read_fn = read_fn
         self.workers = workers
         self.producers = max(1, producers)
+        # Telemetry: batches are stamped at creation (obs.lineage) and the
+        # consumer closes the loop into pipeline_decode_ms /
+        # pipeline_batch_age_ms histograms on the process registry.
+        self.registry = default_registry()
 
     def __len__(self) -> int:
         return len(self.plan)
@@ -132,15 +140,28 @@ class DataPipeline:
     def _produce(self, q: "queue.Queue", stop: threading.Event) -> None:
         try:
             if self.workers is not None:
-                for out in self.workers.imap(self.plan):
+                it = self.workers.imap(self.plan)
+                for seq in range(len(self.plan)):
                     if stop.is_set():
                         return
-                    q.put(out)
+                    t0 = time.monotonic_ns()
+                    with span("pipeline.decode", batch_seq=seq):
+                        out = next(it)
+                    # Worker-pool path: the producer only waits on results,
+                    # so this is the pipelined arrival gap, not decode CPU.
+                    decode_ms = (time.monotonic_ns() - t0) / 1e6
+                    q.put((make_lineage(seq, decode_ms), out))
             else:
-                for item in self.plan:
+                for seq, item in enumerate(self.plan):
                     if stop.is_set():
                         return
-                    q.put(self.decode_fn(self.read_fn(self.dataset, item)))
+                    t0 = time.monotonic_ns()
+                    with span("pipeline.decode", batch_seq=seq):
+                        out = self.decode_fn(
+                            self.read_fn(self.dataset, item)
+                        )
+                    decode_ms = (time.monotonic_ns() - t0) / 1e6
+                    q.put((make_lineage(seq, decode_ms), out))
             q.put(_SENTINEL)
         except BaseException as exc:  # surface worker errors to the consumer
             q.put(exc)
@@ -190,11 +211,15 @@ class DataPipeline:
                     return
                 if isinstance(item, BaseException):
                     raise item
+                lineage, batch = item
+                # Close the loop: creation→pickup age (prefetch-queue dwell
+                # + any consumer lag) and the stamped decode duration.
+                observe_local_lineage(self.registry, lineage)
                 if self.device_put_fn is not None:
                     # device_put on the consumer thread: enqueues an async H2D
                     # DMA; the next decode proceeds in the producer meanwhile.
-                    item = self.device_put_fn(item)
-                yield item
+                    batch = self.device_put_fn(batch)
+                yield batch
         finally:
             stop.set()
             # Drain so the producer's blocked put() can observe the stop flag.
@@ -228,13 +253,21 @@ class DataPipeline:
 
         def produce(k: int) -> None:
             try:
-                for item in self.plan[k::n]:
+                for j, item in enumerate(self.plan[k::n]):
+                    seq = k + j * n
                     if stop.is_set():
                         return
-                    out = self.decode_fn(self.read_fn(self.dataset, item))
-                    if self.device_put_fn is not None:
-                        out = self.device_put_fn(out)
-                    queues[k].put(out)
+                    t0 = time.monotonic_ns()
+                    with span("pipeline.decode", batch_seq=seq, producer=k):
+                        out = self.decode_fn(
+                            self.read_fn(self.dataset, item)
+                        )
+                        if self.device_put_fn is not None:
+                            out = self.device_put_fn(out)
+                    # decode_ms here covers decode + device_put dispatch —
+                    # both run in the producer on this path.
+                    decode_ms = (time.monotonic_ns() - t0) / 1e6
+                    queues[k].put((make_lineage(seq, decode_ms), out))
                 queues[k].put(_SENTINEL)
             except BaseException as exc:  # surface errors to the consumer
                 queues[k].put(exc)
@@ -263,7 +296,9 @@ class DataPipeline:
                     continue
                 if isinstance(item, BaseException):
                     raise item
-                yield item
+                lineage, batch = item
+                observe_local_lineage(self.registry, lineage)
+                yield batch
         finally:
             stop.set()
             # Drain so blocked put()s can observe the stop flag.

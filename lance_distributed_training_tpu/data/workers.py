@@ -28,6 +28,7 @@ When to use which decode parallelism:
 from __future__ import annotations
 
 import multiprocessing as mp
+import time
 import weakref
 from collections import deque
 from concurrent.futures import ProcessPoolExecutor
@@ -35,6 +36,8 @@ from typing import Callable, Iterable, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
+
+from ..obs.registry import default_registry
 
 __all__ = ["WorkerPool", "columnar_spec", "folder_spec", "RETRYABLE_READ_ERRORS"]
 
@@ -177,19 +180,32 @@ class WorkerPool:
         decoding an epoch nobody will consume; the pool itself stays warm for
         the next epoch (``persistent_workers`` parity) — only
         :meth:`shutdown` / context-manager exit / GC tears it down.
+
+        Telemetry: each head-of-line result wait lands in the
+        ``workers_result_wait_ms`` histogram (process registry) — near-zero
+        means workers outrun the consumer, sustained large values mean the
+        pool (or the IPC pickling) is the bottleneck.
         """
         if self.closed:
             raise RuntimeError("WorkerPool is shut down")
         window = window or 2 * self.num_workers
+        wait_hist = default_registry().histogram("workers_result_wait_ms")
+
+        def _result(fut):
+            t0 = time.monotonic_ns()
+            out = fut.result()
+            wait_hist.observe((time.monotonic_ns() - t0) / 1e6)
+            return out
+
         it = iter(items)
         pending: deque = deque()
         try:
             for item in it:
                 pending.append(self._pool.submit(_run_item, item))
                 if len(pending) >= window:
-                    yield pending.popleft().result()
+                    yield _result(pending.popleft())
             while pending:
-                yield pending.popleft().result()
+                yield _result(pending.popleft())
         finally:
             for fut in pending:
                 fut.cancel()

@@ -1,0 +1,65 @@
+"""Observability: metrics registry, span tracing, batch lineage, exporters.
+
+The telemetry layer the BASELINE north-star metric ("<2% of step time
+blocked on the loader") needs once the pipeline is disaggregated: ad-hoc
+counters can say *that* a stall happened, only end-to-end attribution can
+say *where it was born* — fragment read vs decode vs queue vs wire vs H2D.
+
+* :mod:`.registry` — thread-safe counters / gauges / fixed-bucket
+  histograms (p50/p95/p99 by bucket interpolation, bounded memory), one
+  process-wide :func:`~.registry.default_registry` every layer meets in;
+* :mod:`.spans` — monotonic-clock span tracer (ring buffer, parent ids)
+  with Chrome-trace/Perfetto export (``ldt trace export``) and
+  ``jax.profiler.TraceAnnotation`` passthrough;
+* :mod:`.lineage` — per-batch ``(batch_seq, created_ns, stage_timings)``
+  stamps carried through the data plane (and the service wire, versioned +
+  backward compatible), closed into ``batch_age_ms``/``wire_ms``/
+  ``queue_wait_ms``/``decode_ms`` histograms at the consumer;
+* :mod:`.http` — stdlib ``/metrics`` (Prometheus text) + ``/healthz``
+  exporter (``--metrics_port`` on ``serve-data`` and ``train``).
+
+Deliberately dependency-free (stdlib only; jax is optional) so decode-only
+service hosts carry the same telemetry as trainers.
+"""
+
+from .http import MetricsHTTPServer  # noqa: F401
+from .lineage import (  # noqa: F401
+    make_lineage,
+    observe_local_lineage,
+    observe_wire_lineage,
+)
+from .registry import (  # noqa: F401
+    DEFAULT_MS_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    render_prometheus,
+)
+from .spans import (  # noqa: F401
+    Span,
+    SpanTracer,
+    chrome_trace,
+    default_tracer,
+    span,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "MetricsHTTPServer",
+    "DEFAULT_MS_BUCKETS",
+    "default_registry",
+    "render_prometheus",
+    "Span",
+    "SpanTracer",
+    "chrome_trace",
+    "default_tracer",
+    "span",
+    "make_lineage",
+    "observe_wire_lineage",
+    "observe_local_lineage",
+]

@@ -1,0 +1,311 @@
+"""Lightweight span tracing with a Chrome-trace / Perfetto export surface.
+
+A :class:`SpanTracer` records named regions (monotonic-clock start/stop,
+parent ids from a per-thread stack) into a bounded ring buffer — the
+always-on, ~zero-cost sibling of ``jax.profiler`` traces. Three export
+surfaces:
+
+* **Perfetto / chrome://tracing** — :func:`chrome_trace` converts completed
+  spans to Chrome trace-event JSON (``ph: "X"`` complete events), written by
+  :meth:`SpanTracer.write_chrome_trace` or the ``ldt trace export`` CLI
+  (:func:`trace_main`).
+* **XPlane passthrough** — every span also enters a
+  ``jax.profiler.TraceAnnotation`` when jax is importable, so the same
+  regions appear on the host timeline of a ``jax.profiler`` trace
+  (``utils/profiling.trace``). No-op (and no jax import cost) otherwise.
+* **cross-process JSONL** — set ``LDT_TRACE_PATH`` (or pass ``jsonl_path``)
+  and completed spans append to a JSONL file one event per line; ``ldt
+  trace export --spans that-file`` stitches any number of processes'
+  files into one Perfetto-loadable trace.
+
+Clocks: span durations come from ``time.monotonic_ns`` (LDT601 forbids
+``time.time()`` here); the JSONL/export timestamps are the same monotonic
+microseconds, which Perfetto renders relative — absolute wall alignment
+across hosts is the lineage layer's job, not the tracer's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Iterator, List, Optional
+
+__all__ = [
+    "Span",
+    "SpanTracer",
+    "default_tracer",
+    "span",
+    "chrome_trace",
+    "trace_main",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Span:
+    """One completed region. Times are ``time.monotonic_ns()`` instants."""
+
+    name: str
+    start_ns: int
+    end_ns: int
+    span_id: int
+    parent_id: int  # 0 = root
+    thread_id: int
+    pid: int
+    attrs: Optional[dict] = None
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_ns - self.start_ns) / 1e6
+
+    def to_event(self) -> dict:
+        """Chrome trace-event dict (``ph: "X"`` complete event; ts/dur in
+        microseconds — the Perfetto/chrome://tracing contract)."""
+        args = {"span_id": self.span_id, "parent_id": self.parent_id}
+        if self.attrs:
+            args.update(self.attrs)
+        return {
+            "name": self.name,
+            "ph": "X",
+            "ts": self.start_ns / 1e3,
+            "dur": (self.end_ns - self.start_ns) / 1e3,
+            "pid": self.pid,
+            "tid": self.thread_id,
+            "args": args,
+        }
+
+
+def _annotation(name: str):
+    """``jax.profiler.TraceAnnotation`` when jax is importable, else None —
+    the tracer must work in decode-only processes without jax installed."""
+    global _ANNOTATION_CLS
+    if _ANNOTATION_CLS is False:
+        return None
+    if _ANNOTATION_CLS is None:
+        try:
+            import jax
+
+            _ANNOTATION_CLS = jax.profiler.TraceAnnotation
+        except Exception:  # jax absent/broken: tracer still works
+            _ANNOTATION_CLS = False
+            return None
+    return _ANNOTATION_CLS(name)
+
+
+_ANNOTATION_CLS = None  # unresolved | False (unavailable) | the class
+
+
+class SpanTracer:
+    """Thread-safe tracer: a ring buffer of completed spans.
+
+    ``capacity`` bounds memory forever (old spans fall off the back — the
+    recent-window view an engineer actually opens). ``jsonl_path`` (or the
+    ``LDT_TRACE_PATH`` env var) additionally appends every completed span as
+    one JSON line, the durable form ``ldt trace export`` consumes.
+    """
+
+    def __init__(self, capacity: int = 4096,
+                 jsonl_path: Optional[str] = None):
+        self._lock = threading.Lock()  # ring buffer only — never held for IO
+        self._io_lock = threading.Lock()  # JSONL handle; a slow flush must
+        # not block threads opening spans or appending to the ring
+        self._spans: deque = deque(maxlen=max(1, capacity))
+        self._local = threading.local()
+        self._ids = itertools.count(1)  # GIL-atomic: id allocation is lockless
+        self._jsonl = None
+        self._jsonl_path = jsonl_path or os.environ.get("LDT_TRACE_PATH")
+
+    # -- recording ---------------------------------------------------------
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @contextmanager
+    def span(self, name: str, **attrs) -> Iterator[None]:
+        """Record the enclosed block as one span; nests (parent = innermost
+        open span on this thread) and mirrors into the jax profiler's host
+        timeline when a profiler trace is active."""
+        stack = self._stack()
+        span_id = next(self._ids)
+        parent_id = stack[-1] if stack else 0
+        stack.append(span_id)
+        annotation = _annotation(name)
+        start = time.monotonic_ns()
+        try:
+            if annotation is not None:
+                with annotation:
+                    yield
+            else:
+                yield
+        finally:
+            end = time.monotonic_ns()
+            stack.pop()
+            self._record(Span(
+                name=name, start_ns=start, end_ns=end, span_id=span_id,
+                parent_id=parent_id, thread_id=threading.get_ident() % 2**31,
+                pid=os.getpid(), attrs=attrs or None,
+            ))
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+        if self._jsonl_path is None:
+            return
+        # Serialize + flush outside the ring lock: a stalled disk slows the
+        # writer, not every thread opening a span. Flush-per-span is the
+        # durability contract (`ldt trace export` must see spans from
+        # processes that died mid-run).
+        line = json.dumps(span.to_event()) + "\n"
+        with self._io_lock:
+            if self._jsonl_path is None:
+                return
+            if self._jsonl is None:
+                try:
+                    self._jsonl = open(self._jsonl_path, "a")
+                except OSError:
+                    self._jsonl_path = None  # never retry a bad path
+                    return
+            self._jsonl.write(line)
+            self._jsonl.flush()
+
+    # -- reading / export --------------------------------------------------
+
+    def spans(self) -> List[Span]:
+        """Completed spans, oldest first (bounded by ``capacity``)."""
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def chrome_trace(self) -> dict:
+        return chrome_trace([s.to_event() for s in self.spans()])
+
+    def write_chrome_trace(self, path: str) -> str:
+        """Dump the ring buffer as a Perfetto-loadable JSON file."""
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+            f.write("\n")
+        return path
+
+    def close(self) -> None:
+        """Terminal: spans completing after close (e.g. on a daemon thread
+        racing shutdown) still enter the ring buffer but no longer reopen
+        the JSONL file."""
+        with self._io_lock:
+            self._jsonl_path = None
+            if self._jsonl is not None:
+                self._jsonl.close()
+                self._jsonl = None
+
+
+def chrome_trace(events: List[dict]) -> dict:
+    """Wrap trace events in the Chrome trace-event JSON envelope."""
+    return {
+        "traceEvents": list(events),
+        "displayTimeUnit": "ms",
+        "otherData": {"exporter": "ldt trace export"},
+    }
+
+
+_DEFAULT: Optional[SpanTracer] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_tracer() -> SpanTracer:
+    """The process-wide tracer every instrumented layer records into.
+    Created lazily so ``LDT_TRACE_PATH`` set by the entry point (CLI, test)
+    is read at first use, not at import."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = SpanTracer()
+        return _DEFAULT
+
+
+def span(name: str, **attrs):
+    """Record a region on the process-wide tracer — the one-liner the
+    instrumented modules use: ``with span("svc.decode", step=n): …``."""
+    return default_tracer().span(name, **attrs)
+
+
+# -- `ldt trace` CLI ---------------------------------------------------------
+
+
+def trace_main(argv=None, out=None) -> int:
+    """``ldt trace export`` — convert recorded span JSONL (written by any
+    process running with ``LDT_TRACE_PATH``) into one Chrome-trace JSON
+    loadable in Perfetto (https://ui.perfetto.dev) or chrome://tracing.
+    Returns the process exit status."""
+    import argparse
+    import sys
+
+    out = out if out is not None else sys.stdout
+    p = argparse.ArgumentParser(
+        prog="ldt trace",
+        description="Export recorded spans as a Perfetto-loadable "
+                    "Chrome-trace JSON",
+    )
+    sub = p.add_subparsers(dest="command")
+    exp = sub.add_parser("export", help="convert span JSONL → Chrome trace")
+    exp.add_argument(
+        "--spans", action="append", default=None, metavar="JSONL",
+        help="span JSONL file(s) written under LDT_TRACE_PATH (repeatable; "
+             "default: $LDT_TRACE_PATH or ldt-spans.jsonl)",
+    )
+    exp.add_argument("--out", default="ldt-trace.json",
+                     help="output Chrome-trace JSON path")
+    args = p.parse_args(list(argv) if argv is not None else None)
+    if args.command != "export":
+        p.print_help(out)
+        return 2
+    spans_paths = args.spans or [
+        os.environ.get("LDT_TRACE_PATH", "ldt-spans.jsonl")
+    ]
+    events: List[dict] = []
+    missing = []
+    for path in spans_paths:
+        if not os.path.exists(path):
+            missing.append(path)
+            continue
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, start=1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    out.write(
+                        f"ldt trace: skipping undecodable line "
+                        f"{path}:{lineno}\n"
+                    )
+    if missing:
+        # A partial multi-process merge must say so: a silently dropped
+        # host's spans read as "that host did nothing" in Perfetto.
+        out.write(
+            f"ldt trace: missing span file(s): {', '.join(missing)}\n"
+        )
+        if not events:
+            out.write(
+                "ldt trace: no events collected — run with "
+                "LDT_TRACE_PATH=<file> to record spans\n"
+            )
+            return 2
+    with open(args.out, "w") as f:
+        json.dump(chrome_trace(events), f)
+        f.write("\n")
+    out.write(
+        f"ldt trace: wrote {len(events)} events to {args.out} — open it at "
+        "https://ui.perfetto.dev or chrome://tracing\n"
+    )
+    return 0

@@ -24,8 +24,12 @@ import socket
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Callable, Iterator, Optional, Sequence
 
+from ..obs.lineage import observe_wire_lineage
+from ..obs.registry import MetricsRegistry, default_registry
+from ..obs.spans import span
 from ..utils.metrics import ServiceCounters
 from . import protocol as P
 
@@ -60,6 +64,7 @@ class RemoteLoader:
         timeout_s: float = 120.0,
         task_type: Optional[str] = None,
         image_size: Optional[int] = None,
+        registry: Optional[MetricsRegistry] = None,
     ):
         host, sep, port = addr.rpartition(":")
         if not sep or not port.isdigit():
@@ -84,8 +89,20 @@ class RemoteLoader:
         # time (silent wrong-resolution training is the alternative).
         self.task_type = task_type
         self.image_size = image_size
-        self.counters = ServiceCounters()
+        self.registry = registry if registry is not None else default_registry()
+        self.counters = ServiceCounters(registry=self.registry)
+        # Lineage loop closure: every v2 batch frame's stamps, merged with
+        # the client-computed ages (batch_age_ms / wire_ms) — histograms go
+        # to the registry, the raw recent window here for tests/debugging.
+        self.recent_lineage: deque = deque(maxlen=1024)
+        self.last_lineage: Optional[dict] = None
         self.client_id = uuid.uuid4().hex
+        # Version this client's HELLO advertises. Starts at the newest we
+        # speak; a v1 server's equality check rejects that, so _connect
+        # downgrades to MIN_PROTOCOL_VERSION and redials. Sticky: later
+        # reconnects (resume-at-cursor) keep speaking the negotiated version
+        # instead of re-tripping the mismatch on every drop.
+        self._hello_version = P.PROTOCOL_VERSION
         self._num_steps: Optional[int] = None
         # Set by the active iteration; test/ops hook: closing it simulates a
         # connection drop and exercises the resume path.
@@ -106,6 +123,7 @@ class RemoteLoader:
             columns=self.columns,
             client_id=self.client_id,
             probe=probe,
+            version=self._hello_version,
             task_type=self.task_type,
             image_size=self.image_size,
         )
@@ -118,7 +136,8 @@ class RemoteLoader:
         and shortens backoff sleeps, so closing an iterator mid-outage
         returns promptly instead of draining the full retry schedule."""
         last: Optional[Exception] = None
-        for attempt in range(max(1, self.connect_retries)):
+        attempt, attempts = 0, max(1, self.connect_retries)
+        while attempt < attempts:
             if stop is not None and stop.is_set():
                 raise ConnectionError("loader closed during connect")
             sock = None
@@ -143,14 +162,39 @@ class RemoteLoader:
                 P.send_msg(sock, P.MSG_HELLO, self._hello(start_step, probe))
                 msg_type, reply = P.recv_msg(sock)
                 if msg_type == P.MSG_ERROR:
-                    # Handshake rejections (version skew, bad shard) are
-                    # permanent — retrying cannot fix them.
+                    message = str(reply.get("message", ""))
+                    if (P.VERSION_MISMATCH_MARKER in message
+                            and self._hello_version
+                            > P.MIN_PROTOCOL_VERSION):
+                        # A v1 server's handshake predates range negotiation
+                        # and rejects any version but its own. Re-offer the
+                        # oldest version this build still speaks (lineage is
+                        # already gated on the peer's echoed version, so a
+                        # downgraded stream simply never carries it). The
+                        # redial is free — the server IS reachable, this is
+                        # negotiation, not a failed attempt — and happens at
+                        # most once (guarded by the version floor above).
+                        self._hello_version = P.MIN_PROTOCOL_VERSION
+                        sock.close()
+                        continue
+                    # Other handshake rejections (bad shard, decode-config
+                    # skew) are permanent — retrying cannot fix them.
                     raise P.ProtocolError(
-                        f"server rejected handshake: {reply.get('message')}"
+                        f"server rejected handshake: {message}"
                     )
                 if msg_type != P.MSG_HELLO_OK:
                     raise P.ProtocolError(
                         f"expected HELLO_OK, got message type {msg_type}"
+                    )
+                # An old (v1) server is fine — it just never sends lineage;
+                # only a version OUTSIDE the range is a hard skew. (Servers
+                # reject those at HELLO, but a v1 server predates range
+                # checks, so the client re-checks its echo.)
+                if not P.version_supported(reply.get("version")):
+                    raise P.ProtocolError(
+                        f"server speaks protocol {reply.get('version')}, "
+                        f"client supports {P.MIN_PROTOCOL_VERSION}.."
+                        f"{P.PROTOCOL_VERSION}"
                     )
                 self._num_steps = int(reply["num_steps"])
                 # Streaming phase: no recv deadline. A slow step (cold
@@ -172,6 +216,7 @@ class RemoteLoader:
                 last = exc
                 self.counters.add("connect_retries")
                 backoff = self.backoff_s * (2**attempt)
+                attempt += 1
                 if stop is not None:
                     if stop.wait(backoff):  # interruptible backoff
                         raise ConnectionError(
@@ -227,11 +272,29 @@ class RemoteLoader:
                     self._conn = sock
                     continue
                 if msg_type == P.MSG_BATCH:
-                    step, batch = P.decode_batch(payload["raw"])
+                    # Arrival stamp BEFORE deserialisation: wire_ms must
+                    # measure send→arrival, not send→decoded — on large
+                    # frames the frombuffer copies cost real ms and would
+                    # misattribute CPU time to the network.
+                    recv_ns = time.time_ns()
+                    with span("client.decode", step=next_step):
+                        step, batch, lineage = P.decode_batch(
+                            payload["raw"], with_lineage=True
+                        )
                     if step != next_step:
                         raise P.ProtocolError(
                             f"out-of-order step {step}, expected {next_step}"
                         )
+                    # Close the lineage loop: batch_age_ms (creation→here),
+                    # wire_ms (send→here), queue_wait/decode passthrough —
+                    # lineage_* histograms per received batch. None (a v1
+                    # server, or lineage gated off) is interop, not error.
+                    observed = observe_wire_lineage(
+                        self.registry, lineage, recv_ns
+                    )
+                    if observed is not None:
+                        self.last_lineage = observed
+                        self.recent_lineage.append(observed)
                     next_step += 1
                     try:
                         P.send_msg(sock, P.MSG_ACK, {"step": step})

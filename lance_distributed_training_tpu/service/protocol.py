@@ -23,8 +23,19 @@ another conversion (the same ``dict[str, np.ndarray]`` contract
 
 The handshake is versioned: a client opens with HELLO carrying
 ``PROTOCOL_VERSION``; the server answers HELLO_OK (echoing its version and
-the plan's step count) or ERROR — a version skew fails loudly at connect
-time, never as a mid-epoch deserialisation crash.
+the plan's step count) or ERROR — an unsupported version skew fails loudly
+at connect time, never as a mid-epoch deserialisation crash. Versions are a
+compatibility *range*: each side accepts peers within
+[``MIN_PROTOCOL_VERSION``, ``PROTOCOL_VERSION``] and speaks the features of
+``min(mine, peer)``, so a v1 peer on either end of a v2 process still
+interops.
+
+Version 2 adds the optional **lineage** field to the batch meta (an extra
+JSON key — ``{batch_seq, created_ns, decode_ms, queue_wait_ms, sent_ns}``,
+see :mod:`..obs.lineage`). Backward compatible by construction: a v1
+decoder ignores unknown meta keys, and a v2 server simply omits the field
+for v1 clients; ``decode_batch(..., with_lineage=True)`` returns ``None``
+for its absence.
 """
 
 from __future__ import annotations
@@ -39,6 +50,10 @@ import numpy as np
 
 __all__ = [
     "PROTOCOL_VERSION",
+    "MIN_PROTOCOL_VERSION",
+    "LINEAGE_MIN_VERSION",
+    "version_supported",
+    "VERSION_MISMATCH_MARKER",
     "MSG_HELLO",
     "MSG_HELLO_OK",
     "MSG_BATCH",
@@ -50,11 +65,36 @@ __all__ = [
     "send_msg",
     "recv_msg",
     "encode_batch",
+    "encode_tensors",
+    "encode_batch_meta",
+    "send_batch_frame",
     "decode_batch",
     "ProtocolError",
 ]
 
-PROTOCOL_VERSION = 1
+PROTOCOL_VERSION = 2
+# Oldest peer version this build still speaks. v1 framing is a strict
+# subset of v2 (no lineage meta key), so the floor stays at 1.
+MIN_PROTOCOL_VERSION = 1
+# First version whose batch meta may carry the lineage field.
+LINEAGE_MIN_VERSION = 2
+# Error-message prefix every version rejection starts with — the marker the
+# client's downgrade retry keys on. FROZEN wire prose: deployed v1 servers
+# already say exactly "protocol version mismatch: server 1, client N", and
+# a v2 client must recognize THEIR rejection, so rewording this constant
+# (or a server's message) silently breaks new-client -> old-server interop.
+VERSION_MISMATCH_MARKER = "protocol version mismatch"
+
+
+def version_supported(version) -> bool:
+    """Is ``version`` (a peer's HELLO/HELLO_OK claim) in this build's
+    compatibility range? Non-integers are unsupported, never a crash."""
+    return (
+        isinstance(version, int)
+        and not isinstance(version, bool)  # JSON true is not a version
+        and MIN_PROTOCOL_VERSION <= version <= PROTOCOL_VERSION
+    )
+
 
 # Message types (one byte on the wire).
 MSG_HELLO = 1  # client -> server: version + shard/plan parameters
@@ -154,25 +194,68 @@ def recv_msg(
     return msg_type, out
 
 
-def encode_batch(step: int, batch: dict) -> bytes:
+def encode_batch(step: int, batch: dict,
+                 lineage: Optional[dict] = None) -> bytes:
     """One plan step's host batch → a MSG_BATCH payload.
 
     Arrays are serialised raw (C-contiguous dtype/shape + buffer), never
-    pickled — the hot path moves bytes, not objects.
+    pickled — the hot path moves bytes, not objects. ``lineage`` (v2+,
+    :mod:`..obs.lineage`) rides the JSON meta as an extra key: a v1 decoder
+    reads ``step``/``tensors`` and never sees it.
+    """
+    metas, body = encode_tensors(batch)
+    meta = encode_batch_meta(step, metas, lineage)
+    return b"".join([_META_LEN.pack(len(meta)), meta, body])
+
+
+def encode_tensors(batch: dict) -> Tuple[list, bytes]:
+    """Serialise a host batch's arrays → ``(tensor_metas, body_bytes)``.
+
+    This is the expensive half of :func:`encode_batch` (the multi-MB join
+    copy). Split out so a producer can pay it off the send thread, leaving
+    only the small stamp-carrying meta (:func:`encode_batch_meta`) to build
+    at send time — otherwise encode CPU masquerades as wire latency.
     """
     metas, buffers = [], []
     for name, arr in batch.items():
         arr = np.ascontiguousarray(arr)
         metas.append([name, arr.dtype.str, list(arr.shape)])
         buffers.append(arr.data if arr.size else b"")
-    meta = json.dumps({"step": int(step), "tensors": metas}).encode("utf-8")
-    parts = [_META_LEN.pack(len(meta)), meta]
-    parts.extend(buffers)
-    return b"".join(parts)
+    return metas, b"".join(buffers)
 
 
-def decode_batch(payload) -> Tuple[int, dict]:
-    """MSG_BATCH payload → ``(step, {name: np.ndarray})``.
+def encode_batch_meta(step: int, tensor_metas: list,
+                      lineage: Optional[dict] = None) -> bytes:
+    """The small JSON meta half of a MSG_BATCH payload (see
+    :func:`encode_batch` for the lineage/v1 contract)."""
+    header = {"step": int(step), "tensors": tensor_metas}
+    if lineage is not None:
+        header["lineage"] = lineage
+    return json.dumps(header).encode("utf-8")
+
+
+def send_batch_frame(sock: socket.socket, meta: bytes, body: bytes) -> int:
+    """Send one MSG_BATCH built from :func:`encode_tensors` +
+    :func:`encode_batch_meta` parts, without re-joining the body into a
+    fresh payload copy. Wire bytes are identical to
+    ``send_frame(sock, MSG_BATCH, encode_batch(...))``. Returns the payload
+    length (for bytes-sent accounting)."""
+    payload_len = _META_LEN.size + len(meta) + len(body)
+    if payload_len >= MAX_FRAME:
+        raise ProtocolError(f"frame too large: {payload_len} bytes")
+    # Header + meta are small: one sendall. The body rides its own sendall,
+    # same as send_frame's bulk path.
+    sock.sendall(_HEADER.pack(payload_len, MSG_BATCH)
+                 + _META_LEN.pack(len(meta)) + meta)
+    if body:
+        sock.sendall(body)
+    return payload_len
+
+
+def decode_batch(payload, with_lineage: bool = False):
+    """MSG_BATCH payload → ``(step, {name: np.ndarray})``, or with
+    ``with_lineage=True`` → ``(step, batch, lineage_or_None)`` (``None``
+    when the sender predates — or gated off — the v2 lineage field).
 
     Arrays are copies (the frame buffer is reused by the receive loop), each
     materialised with one ``frombuffer`` + reshape — no element-wise work.
@@ -206,6 +289,11 @@ def decode_batch(payload) -> Tuple[int, dict]:
         raise ProtocolError(
             f"batch frame has {len(view) - offset} trailing bytes"
         )
+    if with_lineage:
+        lineage = meta.get("lineage")
+        return int(meta["step"]), out, (
+            lineage if isinstance(lineage, dict) else None
+        )
     return int(meta["step"]), out
 
 
@@ -224,8 +312,16 @@ def hello(
     probe: bool = False,
     task_type: Optional[str] = None,
     image_size: Optional[int] = None,
+    version: int = PROTOCOL_VERSION,
 ) -> dict:
     """Build the HELLO payload — the client's shard-of-the-plan request.
+
+    ``version`` is the protocol version this HELLO advertises. It defaults
+    to the newest this build speaks; a client re-offers
+    ``MIN_PROTOCOL_VERSION`` after a v1 server (whose handshake predates
+    range negotiation and rejects any version other than its own) refuses
+    the first HELLO — that downgrade retry is what makes
+    new-client -> old-server interop real rather than aspirational.
 
     ``start_step`` is the resume cursor: a reconnecting client passes
     ``last_acked + 1`` and the server serves the identical plan from there
@@ -237,7 +333,7 @@ def hello(
     pooling accepts any spatial size).
     """
     return {
-        "version": PROTOCOL_VERSION,
+        "version": int(version),
         "batch_size": int(batch_size),
         "process_index": int(process_index),
         "process_count": int(process_count),

@@ -49,6 +49,8 @@ from typing import Optional
 
 from ..data.format import Dataset
 from ..data.samplers import assert_equal_step_counts, make_plan
+from ..obs.lineage import make_lineage
+from ..obs.spans import span
 from ..utils.metrics import ServiceCounters
 from . import protocol as P
 
@@ -72,6 +74,12 @@ class ServeConfig:
     read_retries: int = 3  # dataset-read attempts before ERROR
     retry_backoff_s: float = 0.05  # doubles per attempt
     log_every_s: float = 0.0  # >0: periodic stats line to stdout
+    metrics_port: Optional[int] = None  # serve /metrics (Prometheus text) +
+    # /healthz on this port (0 = ephemeral, bound one on
+    # DataService.metrics_port; None = exporter off)
+    metrics_host: str = "127.0.0.1"  # exporter bind address; /healthz leaks
+    # dataset paths + peer addresses unauthenticated, so non-loopback
+    # (0.0.0.0 behind a scrape network) is an explicit opt-in
 
 
 class _ClientSession:
@@ -85,6 +93,7 @@ class _ClientSession:
         self.alive = True
         self.last_acked = -1
         self.client_id = ""
+        self.peer_version = P.PROTOCOL_VERSION  # refined by the HELLO
         # Clamp to >=1: maxsize=0 would mean UNBOUNDED, silently voiding the
         # backpressure guarantee (one stalled trainer buffering the whole
         # remaining epoch server-side).
@@ -114,15 +123,21 @@ class _ClientSession:
                 raise P.ProtocolError(
                     f"expected HELLO, got message type {msg_type}"
                 )
-            if req.get("version") != P.PROTOCOL_VERSION:
+            if not P.version_supported(req.get("version")):
                 P.send_msg(
                     self.sock, P.MSG_ERROR,
                     {"message": (
-                        f"protocol version mismatch: server "
-                        f"{P.PROTOCOL_VERSION}, client {req.get('version')}"
+                        f"{P.VERSION_MISMATCH_MARKER}: server supports "
+                        f"{P.MIN_PROTOCOL_VERSION}..{P.PROTOCOL_VERSION}, "
+                        f"client {req.get('version')}"
                     )},
                 )
                 return
+            # Speak the intersection: v2 features (lineage meta) are gated
+            # on the peer also being v2+.
+            self.peer_version = min(
+                int(req["version"]), P.PROTOCOL_VERSION
+            )
             self.client_id = req.get("client_id", "")
             skew = svc.decode_config_skew(req)
             if skew:
@@ -142,7 +157,11 @@ class _ClientSession:
             self.last_acked = start - 1
             P.send_msg(
                 self.sock, P.MSG_HELLO_OK,
-                {"version": P.PROTOCOL_VERSION, "num_steps": len(plan),
+                # Echo the NEGOTIATED version, not this build's ceiling: a
+                # vN+1 server answering a vN client must echo vN (what the
+                # stream actually speaks), or the client's range check on
+                # the echo rejects a connection the server just accepted.
+                {"version": self.peer_version, "num_steps": len(plan),
                  "start_step": start},
             )
             if req.get("probe") or start == len(plan):
@@ -212,10 +231,33 @@ class _ClientSession:
                     return
                 if isinstance(item, BaseException):
                     raise item
-                step, payload = item
-                P.send_frame(self.sock, P.MSG_BATCH, payload)
+                step, metas, body, lineage, enq_ns = item
+                # Queue dwell = how long this client's consumption lagged
+                # decode; stamped HERE (not in the producer) so the value
+                # covers the whole wait and can still ride the frame.
+                queue_wait_ms = (time.monotonic_ns() - enq_ns) / 1e6
+                svc.counters.observe("queue_wait_ms", queue_wait_ms)
+                # The body was serialised by the producer (overlapping this
+                # thread's previous sendall); only the small meta is built
+                # here so it can carry send-time stamps — nothing heavy
+                # runs between sent_ns and the socket write, so encode CPU
+                # never masquerades as wire latency (mirror of the client
+                # stamping recv_ns before decode).
+                with span("svc.send", step=step, peer=self.peer):
+                    if self.peer_version >= P.LINEAGE_MIN_VERSION:
+                        lineage = dict(
+                            lineage,
+                            queue_wait_ms=round(queue_wait_ms, 3),
+                            sent_ns=time.time_ns(),  # wall stamp
+                        )
+                        # Host-local stamp: meaningless on the peer's clock.
+                        lineage.pop("created_mono_ns", None)
+                    else:  # v1 peer: omit the field (bit-identical v1)
+                        lineage = None
+                    meta = P.encode_batch_meta(step, metas, lineage)
+                    sent = P.send_batch_frame(self.sock, meta, body)
                 svc.counters.add("batches_sent")
-                svc.counters.add("bytes_sent", len(payload))
+                svc.counters.add("bytes_sent", sent)
         finally:
             self._stop.set()
             # Unblock a producer waiting on a full queue so it can exit.
@@ -226,7 +268,14 @@ class _ClientSession:
                     producer.join(timeout=0.1)
 
     def _produce(self, plan, start: int, req: dict) -> None:
-        """Decode plan items [start:] into the bounded queue, in order."""
+        """Decode plan items [start:] into the bounded queue, in order.
+
+        Each batch is stamped at creation (``make_lineage``): plan step as
+        ``batch_seq``, wall-clock ``created_ns``, and the measured
+        ``decode_ms`` (on the worker-pool path that is the pipelined
+        result-arrival gap, not pure decode CPU — still the per-stage wait
+        the lineage attributes). The sender finalises queue/send stamps.
+        """
         svc = self.service
         try:
             items = plan[start:]
@@ -238,14 +287,25 @@ class _ClientSession:
                     svc.decode_fn(svc.read_item(item, columns))
                     for item in items
                 )
-            for offset, batch in enumerate(results):
+            it = iter(results)
+            for offset in range(len(items)):
+                step = start + offset
                 if self._stop.is_set():
                     return
-                payload = P.encode_batch(start + offset, batch)
-                t0 = time.perf_counter()
-                self._q.put((start + offset, payload))
+                t0 = time.monotonic_ns()
+                with span("svc.decode", step=step):
+                    batch = next(it)
+                decode_ms = (time.monotonic_ns() - t0) / 1e6
+                svc.counters.observe("decode_ms", decode_ms)
+                lineage = make_lineage(step, decode_ms)
+                # Serialise HERE so the multi-MB body join overlaps the
+                # sender's sendall of the previous frame; only the small
+                # meta (send-time stamps) is built on the sender.
+                metas, body = P.encode_tensors(batch)
+                t1 = time.perf_counter()
+                self._q.put((step, metas, body, lineage, time.monotonic_ns()))
                 # Producer blocked = this client consumes slower than decode.
-                svc.counters.add("queue_full_s", time.perf_counter() - t0)
+                svc.counters.add("queue_full_s", time.perf_counter() - t1)
                 svc.counters.gauge("queue_depth", self._q.qsize())
             self._q.put(None)
         except BaseException as exc:  # surface to the sender loop
@@ -310,6 +370,8 @@ class DataService:
         self._sessions_lock = threading.Lock()
         self._stopped = threading.Event()
         self.port: Optional[int] = None
+        self._metrics = None  # MetricsHTTPServer when metrics_port is set
+        self.metrics_port: Optional[int] = None  # bound exporter port
 
     # -- data plane --------------------------------------------------------
 
@@ -411,6 +473,29 @@ class DataService:
         sock.listen(64)
         self._sock = sock
         self.port = sock.getsockname()[1]
+        if self.config.metrics_port is not None:
+            from ..obs.http import MetricsHTTPServer
+
+            # Before the accept thread: an exporter bind failure must not
+            # leave a half-initialized service accepting clients. The
+            # counters' registry (the process default unless injected):
+            # svc_* counters/gauges + decode/queue-wait histograms — and, in
+            # a loopback process, any client-side lineage_* histograms too.
+            try:
+                self._metrics = MetricsHTTPServer(
+                    self.counters.registry,
+                    port=self.config.metrics_port,
+                    host=self.config.metrics_host,
+                    healthz_fn=self._healthz,
+                ).start()
+            except OSError:
+                sock.close()
+                self._sock = None
+                raise
+            self.metrics_port = self._metrics.port
+            self._log(
+                f"metrics on :{self.metrics_port} (/metrics, /healthz)"
+            )
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="ldt-svc-accept"
         )
@@ -420,6 +505,33 @@ class DataService:
             f"{self.config.host}:{self.port}"
         )
         return self
+
+    def _healthz(self) -> dict:
+        """Liveness extras for ``/healthz``: queue depths + client liveness
+        per connected session — the at-a-glance 'which trainer is behind'
+        view."""
+        with self._sessions_lock:
+            sessions = list(self._sessions)
+        stopped = self._stopped.is_set()
+        return {
+            # Non-"ok" serves as HTTP 503 (obs.http): a probe pointed here
+            # sees the wind-down while the exporter thread lingers.
+            "status": "degraded" if stopped else "ok",
+            "dataset": self.config.dataset_path,
+            "port": self.port,
+            "active_clients": len(sessions),
+            "stopped": stopped,
+            "sessions": [
+                {
+                    "peer": s.peer,
+                    "client_id": s.client_id,
+                    "protocol_version": s.peer_version,
+                    "last_acked": s.last_acked,
+                    "queue_depth": s._q.qsize(),
+                }
+                for s in sessions
+            ],
+        }
 
     def _accept_loop(self) -> None:
         assert self._sock is not None
@@ -460,6 +572,9 @@ class DataService:
 
     def stop(self) -> None:
         self._stopped.set()
+        if self._metrics is not None:
+            self._metrics.stop()
+            self._metrics = None
         if self._sock is not None:
             try:
                 self._sock.close()

@@ -39,6 +39,7 @@ from flax.training import train_state
 from .data.format import Dataset
 from .data.pipeline import MapStylePipeline, make_train_pipeline
 from .models.tasks import Task, get_task
+from .obs.spans import span as obs_span
 from .parallel.mesh import (
     batch_sharding,
     get_mesh,
@@ -156,6 +157,13 @@ class TrainConfig:
     eval_every: int = 0  # map-style: val every N epochs (lance_map_style.py:109-112)
     seed: int = 0
     run_name: Optional[str] = None
+    metrics_port: Optional[int] = None  # same contract as ServeConfig:
+    # None = exporter off, 0 = ephemeral (bound port in the progress log),
+    # >0 fixed. Process 0 serves /metrics (Prometheus text: trainer_*
+    # step/loader histograms, svc_* RemoteLoader counters, lineage_*
+    # per-batch latency attribution) and /healthz for the run's lifetime.
+    metrics_host: str = "127.0.0.1"  # exporter bind address; non-loopback
+    # is an explicit opt-in (unauthenticated endpoint)
     log_every: int = 50
     log_grad_norm: bool = False  # per-step micro-batch global gradient norm
     # in the progress lines (divergence telemetry; a few fused reductions;
@@ -1016,10 +1024,32 @@ def train(config: TrainConfig) -> dict:
 
     profiling = False
 
-    worker_pool = (
-        None if config.data_service_addr else _make_worker_pool(config, dataset)
-    )
+    # Telemetry scrape surface (--metrics_port): process 0 serves the
+    # process-wide registry — StepTimer's trainer_* histograms, any
+    # RemoteLoader's svc_*/lineage_* series, pipeline_* batch ages — plus a
+    # /healthz liveness body, for the lifetime of the run.
+    exporter = None
+    worker_pool = None
     try:
+        # Everything that can fail lives inside the try — a bind failure on
+        # the exporter port, the metrics_port log write, or a pool-spawn
+        # error must all still run the finally (logger/ckpt close, and the
+        # exporter's bound port once started).
+        if config.metrics_port is not None and jax.process_index() == 0:
+            from .obs.http import MetricsHTTPServer
+            from .obs.registry import default_registry
+
+            exporter = MetricsHTTPServer(
+                default_registry(),
+                port=config.metrics_port,  # 0 = ephemeral, as serve-data
+                host=config.metrics_host,
+                healthz_fn=lambda: {"role": "trainer",
+                                    "run_name": config.run_name,
+                                    "steps": timer.steps},
+            ).start()
+            logger.log({"metrics_port": exporter.port}, to_wandb=False)
+        if not config.data_service_addr:
+            worker_pool = _make_worker_pool(config, dataset)
         return _train_loop(
             config, dataset, val_dataset, mesh, state, rng, train_step,
             eval_step, logger, timer, worker_pool, ckpt, start_epoch,
@@ -1032,6 +1062,8 @@ def train(config: TrainConfig) -> dict:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
+        if exporter is not None:
+            exporter.stop()
         if worker_pool is not None:
             worker_pool.shutdown()
         if ckpt is not None:
@@ -1092,7 +1124,8 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
         epoch_step = 0
         while True:
             timer.loader_start()
-            batch = next(it, None)
+            with obs_span("train.loader", step=global_step):
+                batch = next(it, None)
             timer.loader_stop()
             if batch is None:
                 break
@@ -1141,11 +1174,12 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 # same host batch (TrainConfig.data_echo).
                 rng, step_rng = jax.random.split(rng)
                 timer.step_start()
-                if config.log_grad_norm:
-                    state, loss, gnorm = train_step(state, batch, step_rng)
-                else:
-                    state, loss = train_step(state, batch, step_rng)
-                    gnorm = None
+                with obs_span("train.step", step=global_step):
+                    if config.log_grad_norm:
+                        state, loss, gnorm = train_step(state, batch, step_rng)
+                    else:
+                        state, loss = train_step(state, batch, step_rng)
+                        gnorm = None
                 loss_sum = loss_sum + loss
                 # Bound the async dispatch queue (each in-flight step pins
                 # its global batch on device) — independent of logging, so
@@ -1174,15 +1208,18 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                     # loss (lance_iterable.py:106,116-117). Console/JSONL
                     # only; wandb stays on the per-epoch axis. The loss D2H
                     # is cheap: the fetch above already materialised it.
-                    w = timer.window()
+                    # The wall-clock rate (not the dispatch-time upper
+                    # bound) leads the progress line, so it agrees with the
+                    # epoch metrics' wall-clock rate on async backends.
+                    w = timer.window(batch_size=config.batch_size)
                     wt = w["loader_s"] + w["step_s"]
                     entry = {
                         "step": global_step,
                         "epoch": epoch,
                         "loss": round(float(loss), 4),
-                        "images_per_sec": (
-                            config.batch_size * w["steps"] / wt if wt else 0.0
-                        ),
+                        "images_per_sec": w["images_per_sec_wall"],
+                        "images_per_sec_dispatch":
+                            w["images_per_sec_dispatch"],
                         "loader_stall_pct": (
                             100.0 * w["loader_s"] / wt if wt else 0.0
                         ),
@@ -1247,6 +1284,9 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             ),
             "loader_stall_pct": timer.loader_stall_pct,
         }
+        # Phase-latency distribution (run-wide fixed-bucket histograms):
+        # the p95/p99 tail the mean loader_stall_pct hides.
+        epoch_metrics.update(timer.percentiles())
         if config.data_echo > 1:
             # Rate above counts every echoed step's batch; unique images/sec
             # is that divided by the echo factor — report both honestly.

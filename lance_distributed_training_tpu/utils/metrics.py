@@ -7,6 +7,14 @@ kill-switch (``lance_iterable.py:146``), and run names that encode the
 (loader × sampler × backend) variant (``lance_map_style.py:80``). Falls back
 to JSONL + stdout when wandb is unavailable, and adds the driver-set BASELINE
 metrics the reference lacks: images/sec/chip and loader-stall % of step time.
+
+Since the ``obs/`` subsystem landed, :class:`ServiceCounters` and
+:class:`StepTimer` are thin facades over a shared
+:class:`~..obs.registry.MetricsRegistry`: the ``svc_*`` / ``loader_s`` field
+names (and per-instance ``snapshot``/``window`` semantics) are unchanged,
+but every counter/gauge mirrors into the registry and durations additionally
+feed fixed-bucket histograms — so ``/metrics`` scrapes and p50/p95/p99
+percentiles come for free wherever these classes were already wired.
 """
 
 from __future__ import annotations
@@ -15,9 +23,12 @@ import json
 import os
 import threading
 import time
+import warnings
 from typing import Optional
 
 import jax
+
+from ..obs.registry import Histogram, MetricsRegistry, default_registry
 
 __all__ = ["MetricLogger", "StepTimer", "ServiceCounters"]
 
@@ -31,25 +42,61 @@ class ServiceCounters:
     client), reconnects, and bytes. Attached to a :class:`StepTimer` (or read
     via :meth:`window`), the deltas land in the per-``log_every`` progress
     lines so loader-stall%% stays attributable to a specific side of the wire.
+
+    Facade contract: per-instance state backs :meth:`snapshot` /
+    :meth:`window` / :meth:`percentiles` exactly as before (two instances —
+    or sequential services in one process — never contaminate each other),
+    while every ``add``/``gauge``/``observe`` also lands in ``registry``
+    (default: the process-wide one) under ``<prefix>_<key>`` — the aggregate
+    the ``/metrics`` exporter serves.
     """
 
-    def __init__(self, prefix: str = "svc"):
+    def __init__(self, prefix: str = "svc",
+                 registry: Optional[MetricsRegistry] = None):
         self.prefix = prefix
+        self.registry = registry if registry is not None else default_registry()
         self._lock = threading.Lock()
         self._counts: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
         self._window: dict[str, float] = {}
+        # Per-instance histograms backing percentiles() — same split as
+        # StepTimer._local_hists: the registry series is the process-wide
+        # scrape aggregate, this one is THIS instance's lifetime.
+        self._local_hists: dict[str, Histogram] = {}
 
     def add(self, key: str, value: float = 1.0) -> None:
         """Accumulate a monotonically-growing counter (stall seconds, batches
         served, reconnects, bytes)."""
         with self._lock:
             self._counts[key] = self._counts.get(key, 0.0) + value
+        self.registry.counter(f"{self.prefix}_{key}").inc(value)
 
     def gauge(self, key: str, value: float) -> None:
         """Set an instantaneous gauge (queue depth, active clients)."""
         with self._lock:
             self._gauges[key] = float(value)
+        self.registry.gauge(f"{self.prefix}_{key}").set(value)
+
+    def observe(self, key: str, value: float) -> None:
+        """Record one observation into the ``<prefix>_<key>`` histogram
+        (fixed ms buckets) — durations gain p50/p95/p99 without any change
+        to the snapshot/window counter surface."""
+        with self._lock:
+            local = self._local_hists.get(key)
+            if local is None:
+                local = self._local_hists[key] = Histogram(
+                    f"{self.prefix}_{key}"
+                )
+        local.observe(value)
+        self.registry.histogram(f"{self.prefix}_{key}").observe(value)
+
+    def percentiles(self, key: str) -> dict:
+        """``{"p50": …, "p95": …, "p99": …}`` of THIS instance's
+        :meth:`observe`'d key (empty dict before the first observation) —
+        never blended with another instance's registry aggregate."""
+        with self._lock:
+            hist = self._local_hists.get(key)
+        return hist.percentiles() if hist is not None else {}
 
     def snapshot(self) -> dict:
         """Current totals + gauges, keys prefixed (``svc_*``)."""
@@ -93,6 +140,7 @@ class MetricLogger:
         self.enabled = self.is_main
         self._wandb = None
         self._jsonl = None
+        self._wandb_disabled_reason: Optional[str] = None
         if not self.is_main:
             return
         if enabled:
@@ -101,8 +149,19 @@ class MetricLogger:
 
                 self._wandb = wandb
                 wandb.init(project=project, config=config or {}, name=run_name)
-            except Exception:
+            except Exception as exc:
+                # Never silently: the operator asked for wandb (no --no_wandb)
+                # and is getting the fallback — one warning naming the cause,
+                # and the first JSONL record carries it durably.
                 self._wandb = None
+                self._wandb_disabled_reason = (
+                    f"{type(exc).__name__}: {exc}"
+                )
+                warnings.warn(
+                    f"wandb.init failed ({type(exc).__name__}); metrics "
+                    "fall back to JSONL+stdout only",
+                    stacklevel=2,
+                )
         path = jsonl_path or os.environ.get("LDT_METRICS_PATH", "metrics.jsonl")
         try:
             self._jsonl = open(path, "a")
@@ -121,6 +180,10 @@ class MetricLogger:
         record = dict(metrics)
         if step is not None:
             record["step"] = step
+        if self._wandb_disabled_reason is not None:
+            # First record only: why the wandb sink is absent this run.
+            record["wandb_disabled_reason"] = self._wandb_disabled_reason
+            self._wandb_disabled_reason = None
         if self._wandb is not None and to_wandb:
             self._wandb.log(metrics, step=step)
         if self._jsonl is not None:
@@ -162,10 +225,25 @@ class StepTimer:
 
         timer.loader_start(); batch = next(it); timer.loader_stop()
         timer.step_start();   loss = step(batch); timer.step_stop()
+
+    Facade contract: the ``loader_s``/``step_s``/``steps`` fields are
+    unchanged; each ``*_stop`` additionally observes a ``trainer_loader_ms``
+    / ``trainer_step_ms`` histogram — twice: into a **per-timer** histogram
+    backing :meth:`percentiles` (so one ``train()``'s reported tails are
+    never contaminated by an earlier run in the same process), and into the
+    shared ``registry`` aggregate scraped at ``/metrics``.
     """
 
-    def __init__(self):
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None else default_registry()
         self._counters: Optional[ServiceCounters] = None
+        # Per-instance histograms (percentiles() = this timer's lifetime,
+        # i.e. one train() run); the registry aggregate (the scrape view)
+        # is resolved by name at each *_stop.
+        self._local_hists = {
+            phase: Histogram(f"trainer_{phase}_ms")
+            for phase in ("loader", "step")
+        }
         self.reset()
 
     def reset(self) -> None:
@@ -176,6 +254,11 @@ class StepTimer:
         self._w_loader = 0.0
         self._w_step = 0.0
         self._w_steps = 0
+        # Wall-clock window anchor: on async backends the loader/step
+        # segments cover only host dispatch, so their sum under-counts real
+        # elapsed time and inflates rates — window() rates divide by the
+        # window's wall width instead.
+        self._w_wall = time.perf_counter()
 
     def attach_counters(self, counters: Optional[ServiceCounters]) -> None:
         """Merge a :class:`ServiceCounters` window into every ``window()``:
@@ -184,17 +267,37 @@ class StepTimer:
         attributable (server queue empty vs client receive vs device)."""
         self._counters = counters
 
-    def window(self) -> dict:
+    def window(self, batch_size: Optional[int] = None) -> dict:
         """Deltas since the previous ``window()`` call (or ``reset``) — the
-        per-``log_every`` stats for per-step progress lines."""
+        per-``log_every`` stats for per-step progress lines. ``wall_s`` is
+        the wall-clock width of the window: rates computed against it hold
+        on async backends where ``loader_s + step_s`` covers only dispatch.
+
+        With ``batch_size`` the window also carries the two rates progress
+        lines report: ``images_per_sec_wall`` (against ``wall_s`` — the
+        honest throughput, agreeing with epoch metrics) and
+        ``images_per_sec_dispatch`` (against the dispatch-time sum — an
+        upper bound, useful for spotting dispatch-side regressions)."""
+        now = time.perf_counter()
         out = {
             "steps": self.steps - self._w_steps,
             "loader_s": self.loader_s - self._w_loader,
             "step_s": self.step_s - self._w_step,
+            "wall_s": now - self._w_wall,
         }
+        if batch_size:
+            images = out["steps"] * batch_size
+            dispatch = out["loader_s"] + out["step_s"]
+            out["images_per_sec_wall"] = (
+                images / out["wall_s"] if out["wall_s"] > 0 else 0.0
+            )
+            out["images_per_sec_dispatch"] = (
+                images / dispatch if dispatch > 0 else 0.0
+            )
         self._w_loader = self.loader_s
         self._w_step = self.step_s
         self._w_steps = self.steps
+        self._w_wall = now
         if self._counters is not None:
             out.update(self._counters.window())
         return out
@@ -203,24 +306,42 @@ class StepTimer:
         self._t = time.perf_counter()
 
     def loader_stop(self) -> None:
-        self.loader_s += time.perf_counter() - self._t
+        dt = time.perf_counter() - self._t
+        self.loader_s += dt
+        self._local_hists["loader"].observe(dt * 1e3)
+        self.registry.histogram("trainer_loader_ms").observe(dt * 1e3)
 
     def step_start(self) -> None:
         self._t = time.perf_counter()
 
     def step_stop(self) -> None:
-        self.step_s += time.perf_counter() - self._t
+        dt = time.perf_counter() - self._t
+        self.step_s += dt
         self.steps += 1
+        self._local_hists["step"].observe(dt * 1e3)
+        self.registry.histogram("trainer_step_ms").observe(dt * 1e3)
 
     @property
     def loader_stall_pct(self) -> float:
         total = self.loader_s + self.step_s
         return 100.0 * self.loader_s / total if total > 0 else 0.0
 
+    def percentiles(self) -> dict:
+        """``{"loader_ms_p50": …, …, "step_ms_p99": …}`` over THIS timer's
+        lifetime (the per-instance histograms, not the shared registry
+        aggregate — a second train() in the same process starts clean)."""
+        out = {}
+        for phase, hist in self._local_hists.items():
+            if hist.count:
+                for k, v in hist.percentiles().items():
+                    out[f"{phase}_ms_{k}"] = round(v, 3)
+        return out
+
     def images_per_sec(self, batch_size: int) -> float:
         """Timer-based rate — host dispatch accounting. On async backends
-        the step segments exclude un-fetched device work, so prefer a
-        wall-clock rate (as ``train()``'s epoch metrics do) for throughput
-        claims; this is an upper bound useful for progress lines."""
+        the step segments exclude un-fetched device work, so prefer
+        ``window(batch_size=...)['images_per_sec_wall']`` (or the epoch
+        wall-clock metrics) for throughput claims; this is an upper bound
+        useful for spotting dispatch-side regressions."""
         total = self.loader_s + self.step_s
         return self.steps * batch_size / total if total > 0 else 0.0

@@ -9,7 +9,12 @@ The reference's only instrumentation is coarse epoch wall-clock timers
   (loader / H2D / device step) that powers the loader-stall%% BASELINE
   metric without the full profiler overhead,
 * ``annotate`` — ``TraceAnnotation`` passthrough for marking pipeline phases
-  inside traces.
+  inside traces,
+* ``span`` (re-exported from :mod:`..obs.spans`) — the always-on span
+  tracer: same named regions, but recorded in the process-wide ring buffer
+  (and exported via ``ldt trace export`` → Perfetto) whether or not a
+  jax.profiler trace is active; inside one, spans mirror into the XPlane
+  host timeline through the same ``TraceAnnotation`` machinery.
 """
 
 from __future__ import annotations
@@ -21,7 +26,10 @@ from typing import Iterator, Optional
 
 import jax
 
-__all__ = ["trace", "annotate", "StepProfile"]
+from ..obs.spans import SpanTracer, default_tracer, span  # noqa: F401
+
+__all__ = ["trace", "annotate", "StepProfile", "span", "SpanTracer",
+           "default_tracer"]
 
 
 @contextlib.contextmanager

@@ -3,9 +3,13 @@
 #
 # Stage 1 — `ldt check`: the AST lint over the package (determinism, jit
 # purity, concurrency hygiene, resource ownership, compat enforcement,
-# protocol consistency). Fails fast: a lint finding costs seconds to see
-# here and minutes to rediscover inside a test run.
-# Stage 2 — the tier-1 verify command from ROADMAP.md, verbatim.
+# protocol consistency, obs hygiene). Fails fast: a lint finding costs
+# seconds to see here and minutes to rediscover inside a test run.
+# Stage 2 — telemetry exporter smoke: a short-lived `serve-data` with
+# --metrics_port, one loopback client pass, then fetch /metrics and
+# /healthz (the scriptable curl equivalent, stdlib-only so CI needs no
+# curl binary) and assert the Prometheus histogram series are there.
+# Stage 3 — the tier-1 verify command from ROADMAP.md, verbatim.
 set -e
 cd "$(dirname "$0")/.."
 
@@ -13,6 +17,59 @@ echo "== ldt check =="
 # Standalone runner: the gate must run even when the training package fails
 # to import (catching exactly that is LDT401's job).
 python scripts/ldt_check.py
+
+echo "== telemetry exporter smoke =="
+# timeout: a deadlocked service/loader must fail the stage in minutes, not
+# hang CI until the job-level kill (same policy as the tier-1 stage below).
+timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'PY'
+# Equivalent by hand:
+#   ldt serve-data --dataset_path <ds> --port 0 --metrics_port 9464 &
+#   curl -s localhost:9464/metrics | grep lineage_wire_ms_bucket
+#   curl -s localhost:9464/healthz
+import io, json, pathlib, shutil, tempfile, urllib.request
+import numpy as np, pyarrow as pa
+from PIL import Image
+
+from lance_distributed_training_tpu.data import write_dataset
+from lance_distributed_training_tpu.service import (
+    DataService, RemoteLoader, ServeConfig,
+)
+
+rng = np.random.default_rng(0)
+def jpeg():
+    arr = (rng.random((32, 32, 3)) * 255).astype(np.uint8)
+    buf = io.BytesIO(); Image.fromarray(arr).save(buf, format="JPEG")
+    return buf.getvalue()
+
+tmp = pathlib.Path(tempfile.mkdtemp(prefix="ldt-ci-obs-"))
+table = pa.table({
+    "image": pa.array([jpeg() for _ in range(48)], pa.binary()),
+    "label": pa.array(rng.integers(0, 10, 48), pa.int64()),
+})
+ds = write_dataset(table, tmp / "ds", mode="create", max_rows_per_file=24)
+svc = DataService(ServeConfig(
+    dataset_path=ds.uri, host="127.0.0.1", port=0, image_size=32,
+    metrics_port=0,
+)).start()
+try:
+    n = len(list(RemoteLoader(
+        f"127.0.0.1:{svc.port}", 8, 0, 1,
+        connect_retries=2, backoff_s=0.01,
+    )))
+    base = f"http://127.0.0.1:{svc.metrics_port}"
+    metrics = urllib.request.urlopen(f"{base}/metrics", timeout=10).read().decode()
+    for series in ("svc_batches_sent", "svc_decode_ms_bucket",
+                   "lineage_wire_ms_bucket", "lineage_batch_age_ms_count"):
+        assert series in metrics, f"missing {series} in /metrics"
+    health = json.loads(
+        urllib.request.urlopen(f"{base}/healthz", timeout=10).read()
+    )
+    assert health["status"] == "ok", health
+    print(f"exporter smoke ok: {n} batches, /metrics + /healthz healthy")
+finally:
+    svc.stop()
+    shutil.rmtree(tmp, ignore_errors=True)
+PY
 
 echo "== tier-1 tests =="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -515,6 +515,101 @@ def test_real_protocol_constants_all_resolve():
         assert hasattr(P, name)
 
 
+# -- LDT601 obs hygiene ------------------------------------------------------
+
+
+def test_ldt601_flags_wall_clock_in_instrumented_module(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {"obs/timer.py": """\
+            import time
+
+            def measure(fn):
+                t0 = time.time()
+                fn()
+                return time.time() - t0
+        """},
+        obs_paths=["obs/*"],
+    )
+    assert rule_ids(findings) == ["LDT601", "LDT601"]
+    assert "monotonic" in findings[0].message
+
+
+def test_ldt601_accepts_monotonic_clocks_and_epoch_stamps(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {"obs/timer.py": """\
+            import time
+
+            def measure(fn):
+                t0 = time.perf_counter()
+                fn()
+                return time.perf_counter() - t0
+
+            def stamp():
+                # epoch stamp for cross-process lineage: sanctioned
+                return {"created_ns": time.time_ns(),
+                        "mono": time.monotonic_ns()}
+        """},
+        obs_paths=["obs/*"],
+    )
+    assert findings == []
+
+
+def test_ldt601_ignores_uninstrumented_modules(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {"elsewhere.py": """\
+            import time
+            started_at = time.time()
+        """},
+        obs_paths=["obs/*"],
+    )
+    assert findings == []
+
+
+def test_ldt601_flags_invalid_metric_name(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {"obs/meter.py": """\
+            def wire(registry):
+                registry.counter("svc_batches_sent").inc()
+                registry.histogram("wire_ms").observe(1.0)
+                registry.gauge("Queue-Depth").set(3)
+                registry.counter(name="9starts_with_digit").inc()
+        """},
+        obs_paths=["obs/*"],
+    )
+    assert rule_ids(findings) == ["LDT601", "LDT601"]
+    assert "Prometheus" in findings[0].message
+
+
+def test_ldt601_dynamic_names_not_flagged(tmp_path):
+    # Computed names (f-strings, variables) are validated at runtime by the
+    # registry itself; the static rule only judges literals.
+    findings = run_rules(
+        tmp_path,
+        {"obs/meter.py": """\
+            def wire(registry, prefix, key):
+                registry.counter(f"{prefix}_{key}").inc()
+        """},
+        obs_paths=["obs/*"],
+    )
+    assert findings == []
+
+
+def test_ldt601_suppression(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {"obs/t.py": """\
+            import time
+            t = time.time()  # ldt: ignore[LDT601]
+        """},
+        obs_paths=["obs/*"],
+    )
+    assert findings == []
+
+
 # -- suppressions ------------------------------------------------------------
 
 

@@ -1,0 +1,515 @@
+"""Observability subsystem: registry math/concurrency, Prometheus
+rendering, span tracing + Chrome-trace export (`ldt trace export`), and the
+HTTP exporter. All fast and CPU-only (the obs layer is stdlib-only)."""
+
+import json
+import math
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lance_distributed_training_tpu.obs import (
+    MetricsHTTPServer,
+    MetricsRegistry,
+    SpanTracer,
+    chrome_trace,
+    make_lineage,
+    observe_wire_lineage,
+)
+from lance_distributed_training_tpu.obs.spans import trace_main
+
+pytestmark = pytest.mark.fast
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_counter_gauge_basics():
+    r = MetricsRegistry()
+    c = r.counter("requests_total")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError, match="decrease"):
+        c.inc(-1)
+    g = r.gauge("queue_depth")
+    g.set(7)
+    g.set(3)
+    assert g.value == 3.0
+
+
+def test_registry_get_or_create_and_kind_conflict():
+    r = MetricsRegistry()
+    assert r.counter("x") is r.counter("x")  # aggregation, not shadowing
+    with pytest.raises(ValueError, match="already registered"):
+        r.gauge("x")
+
+
+def test_invalid_metric_name_rejected():
+    r = MetricsRegistry()
+    for bad in ("Upper", "9lead", "has-dash", "has space", ""):
+        with pytest.raises(ValueError, match="invalid metric name"):
+            r.counter(bad)
+
+
+def test_histogram_percentile_interpolation():
+    """Uniform [0, 100) observations: bucket interpolation must land within
+    one bucket width of the exact percentile."""
+    import numpy as np
+
+    r = MetricsRegistry()
+    h = r.histogram("lat_ms")
+    values = np.random.default_rng(0).uniform(0, 100, 2000)
+    for v in values:
+        h.observe(v)
+    for q in (50, 95, 99):
+        exact = float(np.percentile(values, q))
+        est = h.percentile(q)
+        # Buckets near 50..100 are 25-50ms wide — the documented error bound.
+        assert abs(est - exact) < 50.0, (q, est, exact)
+    assert h.count == 2000
+    assert abs(h.sum - float(values.sum())) < 1e-6 * values.sum()
+
+
+def test_histogram_percentile_edge_cases():
+    h = MetricsRegistry().histogram("h", buckets=(1.0, 10.0))
+    assert math.isnan(h.percentile(50))  # empty
+    h.observe(0.5)
+    assert 0.0 <= h.percentile(50) <= 1.0
+    # Overflow bucket clamps to the largest OBSERVATION, not the top finite
+    # bound — a 60 s stall must not report as a 10 s p99.
+    h.observe(1e9)
+    assert h.percentile(99) == 1e9
+
+
+def test_histogram_percentile_matches_prometheus_fractional_rank():
+    """Small samples interpolate the fractional rank, as
+    ``histogram_quantile`` does — a single observation in (1, 10] has
+    p50 = 5.5 (mid-bucket), not the bucket's upper bound."""
+    h = MetricsRegistry().histogram("h", buckets=(1.0, 10.0))
+    h.observe(1.5)
+    assert h.percentile(50) == pytest.approx(5.5)
+    assert h.percentile(100) == pytest.approx(10.0)
+
+
+def test_histogram_concurrent_observe_and_counter_add():
+    """N threads hammering one histogram + one counter: no lost updates."""
+    r = MetricsRegistry()
+    h = r.histogram("conc_ms")
+    c = r.counter("conc_total")
+    n_threads, per_thread = 8, 500
+
+    def work():
+        for i in range(per_thread):
+            h.observe(float(i % 100))
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == n_threads * per_thread
+    assert c.value == n_threads * per_thread
+    counts, _, total = h.snapshot()
+    assert sum(counts) == total
+
+
+def test_prometheus_rendering():
+    r = MetricsRegistry()
+    r.counter("svc_batches_sent").inc(17)
+    r.gauge("svc_queue_depth").set(3)
+    h = r.histogram("wire_ms", buckets=(1.0, 10.0))
+    h.observe(0.5)
+    h.observe(5.0)
+    h.observe(50.0)
+    text = r.render_prometheus()
+    assert "# TYPE svc_batches_sent counter\nsvc_batches_sent 17" in text
+    assert "# TYPE svc_queue_depth gauge\nsvc_queue_depth 3" in text
+    assert '# TYPE wire_ms histogram' in text
+    assert 'wire_ms_bucket{le="1"} 1' in text
+    assert 'wire_ms_bucket{le="10"} 2' in text
+    assert 'wire_ms_bucket{le="+Inf"} 3' in text
+    assert "wire_ms_sum 55.5" in text
+    assert "wire_ms_count 3" in text
+
+
+def test_registry_snapshot_flattens_histograms():
+    r = MetricsRegistry()
+    r.counter("a").inc(2)
+    h = r.histogram("b_ms")
+    h.observe(5.0)
+    snap = r.snapshot()
+    assert snap["a"] == 2.0
+    assert snap["b_ms_count"] == 1
+    assert "b_ms_p95" in snap
+    # Empty histograms must not leak NaN percentiles into the (JSONL-bound)
+    # snapshot — bare NaN tokens break strict JSON consumers.
+    r.histogram("empty_ms")
+    snap = r.snapshot()
+    assert snap["empty_ms_count"] == 0
+    assert "empty_ms_p95" not in snap
+    json.loads(json.dumps(snap, allow_nan=False))
+
+
+def test_registry_histogram_bucket_conflict_raises():
+    r = MetricsRegistry()
+    r.histogram("d_ms", buckets=(1.0, 2.0))
+    assert r.histogram("d_ms", buckets=(1.0, 2.0)).bounds == (1.0, 2.0)
+    with pytest.raises(ValueError, match="already registered with buckets"):
+        r.histogram("d_ms", buckets=(1.0, 2.0, 3.0))
+    with pytest.raises(ValueError, match="already registered with buckets"):
+        r.histogram("d_ms")  # silent fallback to defaults would be worse
+
+
+# -- lineage ----------------------------------------------------------------
+
+
+def test_lineage_observation_records_all_stage_histograms():
+    r = MetricsRegistry()
+    lin = make_lineage(batch_seq=4, decode_ms=12.5)
+    lin.update(queue_wait_ms=3.0, sent_ns=lin["created_ns"] + 1_000_000)
+    out = observe_wire_lineage(r, lin, recv_ns=lin["created_ns"] + 5_000_000)
+    assert out["batch_seq"] == 4
+    assert out["batch_age_ms"] == 5.0
+    assert out["wire_ms"] == 4.0
+    for name in ("lineage_batch_age_ms", "lineage_wire_ms",
+                 "lineage_queue_wait_ms", "lineage_decode_ms"):
+        assert r.get(name).count == 1, name
+    # Absence (old-protocol peer) is interop, not an error.
+    assert observe_wire_lineage(r, None) is None
+
+
+def test_lineage_malformed_peer_values_dropped_not_raised():
+    """v2 lineage is peer-supplied JSON: a non-numeric (or NaN) field must
+    be dropped, never raise out of the receive loop — telemetry is
+    observability-only."""
+    r = MetricsRegistry()
+    lin = {"batch_seq": 1, "created_ns": "abc", "sent_ns": [1, 2],
+           "queue_wait_ms": float("nan"), "decode_ms": 2.0}
+    out = observe_wire_lineage(r, lin, recv_ns=10**9)
+    assert "batch_age_ms" not in out and "wire_ms" not in out
+    assert r.get("lineage_batch_age_ms") is None
+    assert r.get("lineage_queue_wait_ms") is None  # NaN dropped too
+    assert r.get("lineage_decode_ms").count == 1  # good fields still land
+
+
+def test_lineage_clock_skew_clamps_to_zero():
+    r = MetricsRegistry()
+    lin = make_lineage(0, 1.0)
+    out = observe_wire_lineage(r, lin, recv_ns=lin["created_ns"] - 10**9)
+    assert out["batch_age_ms"] == 0.0
+
+
+def test_local_lineage_uses_monotonic_twin():
+    """Same-process ages must survive a wall-clock step: the local observer
+    keys on created_mono_ns, so an NTP jump moving created_ns is ignored."""
+    from lance_distributed_training_tpu.obs.lineage import (
+        observe_local_lineage,
+    )
+
+    r = MetricsRegistry()
+    lin = make_lineage(3, 2.0)
+    lin["created_ns"] += 10**12  # simulated NTP step: wall stamp now bogus
+    out = observe_local_lineage(
+        r, lin, recv_ns=lin["created_mono_ns"] + 7_000_000
+    )
+    assert out["batch_age_ms"] == 7.0  # from the monotonic twin, unfazed
+    assert r.get("pipeline_batch_age_ms").count == 1
+    assert r.get("pipeline_decode_ms").count == 1
+    # A twin-less stamp (older producer) still attributes, via wall clock —
+    # but only against a fresh time.time_ns() "now": a caller-supplied
+    # recv_ns is a monotonic instant here, which the wall-clock fallback
+    # would misread, so it refuses (None) rather than record garbage.
+    legacy = {"batch_seq": 0, "created_ns": 50, "decode_ms": 1.0}
+    assert observe_local_lineage(r, legacy, recv_ns=2_000_050) is None
+    out = observe_local_lineage(r, legacy)
+    assert out["batch_age_ms"] >= 0.0
+    assert r.get("pipeline_batch_age_ms").count == 2
+
+
+# -- spans ------------------------------------------------------------------
+
+
+def test_span_nesting_and_ring_buffer():
+    t = SpanTracer(capacity=8)
+    with t.span("outer", epoch=0):
+        with t.span("inner"):
+            pass
+    spans = t.spans()
+    assert [s.name for s in spans] == ["inner", "outer"]  # completion order
+    inner, outer = spans
+    assert inner.parent_id == outer.span_id
+    assert outer.parent_id == 0
+    assert inner.end_ns >= inner.start_ns
+    assert outer.attrs == {"epoch": 0}
+    for i in range(20):  # ring buffer stays bounded
+        with t.span(f"s{i}"):
+            pass
+    assert len(t.spans()) == 8
+
+
+def test_span_parent_is_per_thread():
+    t = SpanTracer()
+    seen = {}
+
+    def worker():
+        with t.span("threaded"):
+            pass
+
+    with t.span("main_span"):
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join()
+    by_name = {s.name: s for s in t.spans()}
+    # The other thread's span must NOT parent under main's open span.
+    assert by_name["threaded"].parent_id == 0
+    del seen
+
+
+def test_chrome_trace_export_roundtrips(tmp_path):
+    t = SpanTracer()
+    with t.span("decode", step=3):
+        pass
+    out = tmp_path / "trace.json"
+    t.write_chrome_trace(str(out))
+    data = json.load(open(out))
+    assert data["traceEvents"], data
+    ev = data["traceEvents"][0]
+    assert ev["ph"] == "X" and ev["name"] == "decode"
+    assert ev["dur"] >= 0 and ev["args"]["step"] == 3
+
+
+def test_span_jsonl_and_trace_export_cli(tmp_path):
+    """Spans recorded under a jsonl path round-trip through
+    `ldt trace export` into a Perfetto-loadable Chrome trace."""
+    import io
+
+    jsonl = tmp_path / "spans.jsonl"
+    t = SpanTracer(jsonl_path=str(jsonl))
+    with t.span("svc.decode", step=0):
+        pass
+    with t.span("svc.send", step=0):
+        pass
+    t.close()
+    out = tmp_path / "trace.json"
+    buf = io.StringIO()
+    rc = trace_main(
+        ["export", "--spans", str(jsonl), "--out", str(out)], out=buf
+    )
+    assert rc == 0, buf.getvalue()
+    data = json.load(open(out))  # acceptance: round-trips json.load
+    assert len(data["traceEvents"]) == 2
+    assert {e["name"] for e in data["traceEvents"]} == {
+        "svc.decode", "svc.send"
+    }
+
+
+def test_trace_export_cli_missing_file(tmp_path):
+    import io
+
+    buf = io.StringIO()
+    rc = trace_main(
+        ["export", "--spans", str(tmp_path / "nope.jsonl"),
+         "--out", str(tmp_path / "t.json")],
+        out=buf,
+    )
+    assert rc == 2
+    assert "missing span file(s)" in buf.getvalue()
+    assert "no events collected" in buf.getvalue()
+
+
+def test_trace_export_cli_partial_merge_warns(tmp_path):
+    """One present + one missing span file: the export succeeds but names
+    the dropped file — a silent partial merge reads as 'that process did
+    nothing' in Perfetto."""
+    import io
+
+    present = tmp_path / "host-a.jsonl"
+    present.write_text(json.dumps(
+        {"name": "x", "ph": "X", "ts": 1, "dur": 2, "pid": 1, "tid": 1}
+    ) + "\n")
+    buf = io.StringIO()
+    out_path = tmp_path / "t.json"
+    rc = trace_main(
+        ["export", "--spans", str(present),
+         "--spans", str(tmp_path / "host-b.jsonl"),
+         "--out", str(out_path)],
+        out=buf,
+    )
+    assert rc == 0
+    assert "host-b.jsonl" in buf.getvalue()
+    assert len(json.load(open(out_path))["traceEvents"]) == 1
+
+
+def test_ldt_trace_cli_dispatch(tmp_path, monkeypatch):
+    """`ldt trace export` goes through the main CLI dispatcher."""
+    from lance_distributed_training_tpu import cli
+
+    jsonl = tmp_path / "spans.jsonl"
+    t = SpanTracer(jsonl_path=str(jsonl))
+    with t.span("x"):
+        pass
+    t.close()
+    out = tmp_path / "trace.json"
+    rc = cli.main(["trace", "export", "--spans", str(jsonl),
+                   "--out", str(out)])
+    assert rc == 0
+    assert json.load(open(out))["traceEvents"]
+
+
+def test_chrome_trace_envelope():
+    env = chrome_trace([{"name": "a", "ph": "X", "ts": 0, "dur": 1,
+                         "pid": 0, "tid": 0}])
+    assert env["traceEvents"][0]["name"] == "a"
+    json.loads(json.dumps(env))  # serialisable
+
+
+# -- http exporter ----------------------------------------------------------
+
+
+@pytest.fixture()
+def exporter_registry():
+    r = MetricsRegistry()
+    r.counter("svc_batches_sent").inc(5)
+    r.histogram("wire_ms").observe(1.5)
+    return r
+
+
+def test_http_metrics_and_healthz(exporter_registry):
+    depth = {"queue": 4}
+    srv = MetricsHTTPServer(
+        exporter_registry, port=0, host="127.0.0.1",
+        healthz_fn=lambda: {"queue_depth": depth["queue"]},
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "svc_batches_sent 5" in text
+        assert 'wire_ms_bucket{le="+Inf"} 1' in text
+        assert "wire_ms_sum 1.5" in text
+        health = json.loads(
+            urllib.request.urlopen(f"{base}/healthz").read()
+        )
+        assert health == {"status": "ok", "queue_depth": 4}
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nothing")
+    finally:
+        srv.stop()
+
+
+def test_http_healthz_degrades_to_503_not_500(exporter_registry):
+    def boom():
+        raise RuntimeError("probe failed")
+
+    srv = MetricsHTTPServer(
+        exporter_registry, port=0, host="127.0.0.1", healthz_fn=boom
+    ).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/healthz")
+        # 503, not 500: status-code-keyed probes must see failure, but as a
+        # fast well-formed JSON body, not an unhandled server error.
+        assert exc_info.value.code == 503
+        health = json.loads(exc_info.value.read())
+        assert health["status"] == "degraded"
+        assert "probe failed" in health["error"]
+    finally:
+        srv.stop()
+
+
+# -- facades ----------------------------------------------------------------
+
+
+def test_service_counters_mirror_into_registry():
+    from lance_distributed_training_tpu.utils.metrics import ServiceCounters
+
+    r = MetricsRegistry()
+    c = ServiceCounters(registry=r)
+    c.add("batches_sent", 3)
+    c.gauge("queue_depth", 2)
+    c.observe("decode_ms", 7.5)
+    # Per-instance view unchanged...
+    assert c.snapshot() == {"svc_batches_sent": 3.0, "svc_queue_depth": 2.0}
+    # ...and the registry carries the same names plus the histogram.
+    assert r.get("svc_batches_sent").value == 3.0
+    assert r.get("svc_queue_depth").value == 2.0
+    assert r.get("svc_decode_ms").count == 1
+    assert "p95" in c.percentiles("decode_ms")
+    assert c.percentiles("never_observed") == {}
+
+
+def test_service_counters_percentiles_stay_per_instance():
+    """Two facades over ONE registry: percentiles() must report only the
+    instance's own observations (the registry histogram is the blended
+    scrape aggregate — fine for /metrics, wrong for a per-service tail)."""
+    from lance_distributed_training_tpu.utils.metrics import ServiceCounters
+
+    r = MetricsRegistry()
+    a = ServiceCounters(registry=r)
+    b = ServiceCounters(registry=r)
+    for _ in range(100):
+        a.observe("decode_ms", 1.0)
+    b.observe("decode_ms", 9000.0)
+    assert a.percentiles("decode_ms")["p99"] < 10.0  # unfazed by b's 9 s
+    assert b.percentiles("decode_ms")["p50"] > 1000.0
+    assert r.get("svc_decode_ms").count == 101  # aggregate view
+
+
+def test_service_counters_windows_stay_per_instance():
+    """Two facades over ONE registry must not contaminate each other's
+    window deltas (server vs client counters in a loopback process)."""
+    r = MetricsRegistry()
+    a = __import__(
+        "lance_distributed_training_tpu.utils.metrics", fromlist=["*"]
+    ).ServiceCounters(registry=r)
+    b = type(a)(registry=r)
+    a.add("batches_sent", 5)
+    b.add("batches_sent", 7)
+    assert a.window()["svc_batches_sent"] == 5.0
+    assert b.window()["svc_batches_sent"] == 7.0
+    assert r.get("svc_batches_sent").value == 12.0  # aggregate view
+
+
+def test_step_timer_wall_rate_and_histograms():
+    import time
+
+    from lance_distributed_training_tpu.utils.metrics import StepTimer
+
+    r = MetricsRegistry()
+    t = StepTimer(registry=r)
+    t.loader_start(); time.sleep(0.01); t.loader_stop()
+    t.step_start(); time.sleep(0.01); t.step_stop()
+    w = t.window(batch_size=10)
+    assert w["steps"] == 1
+    # The wall window covers at least the two timed segments.
+    assert w["wall_s"] >= w["loader_s"] + w["step_s"] - 1e-4
+    assert t.images_per_sec(10) > 0
+    # Wall rate can never exceed the dispatch-time upper bound.
+    assert (0 < w["images_per_sec_wall"]
+            <= w["images_per_sec_dispatch"] + 1e-6)
+    assert r.get("trainer_loader_ms").count == 1
+    assert r.get("trainer_step_ms").count == 1
+    p = t.percentiles()
+    assert p["loader_ms_p50"] > 0 and p["step_ms_p99"] > 0
+
+
+def test_metric_logger_wandb_failure_warns_and_records(tmp_path, monkeypatch):
+    import sys
+
+    from lance_distributed_training_tpu.utils.metrics import MetricLogger
+
+    monkeypatch.setitem(sys.modules, "wandb", None)  # force import failure
+    path = tmp_path / "m.jsonl"
+    with pytest.warns(UserWarning, match="wandb.init failed"):
+        logger = MetricLogger(enabled=True, jsonl_path=str(path))
+    logger.log({"loss": 1.0}, step=0)
+    logger.log({"loss": 0.5}, step=1)
+    logger.close()
+    records = [json.loads(x) for x in path.read_text().splitlines()]
+    # First record carries the reason (naming the exception class); later
+    # records don't repeat it.
+    assert "ModuleNotFoundError" in records[0]["wandb_disabled_reason"]
+    assert "wandb_disabled_reason" not in records[1]

@@ -201,6 +201,179 @@ def test_early_stop_drains_cleanly(image_dataset, service):
     assert len(list(_loader(service))) == 240 // 16
 
 
+# -- batch lineage over the wire --------------------------------------------
+
+
+def test_lineage_survives_the_wire(image_dataset, service):
+    """Acceptance: every received batch carries its birth certificate —
+    client-observed batch_seq monotonic per shard, batch_age_ms > 0, and
+    the stage timings (decode/queue-wait/wire) land in lineage_* histograms
+    on the loader's registry."""
+    from lance_distributed_training_tpu.obs import MetricsRegistry
+
+    for p in range(2):
+        reg = MetricsRegistry()
+        loader = RemoteLoader(
+            f"127.0.0.1:{service.port}", 16, p, 2,
+            connect_retries=2, backoff_s=0.01, registry=reg,
+        )
+        n = len(list(loader))
+        seqs = [lin["batch_seq"] for lin in loader.recent_lineage]
+        assert seqs == list(range(n))  # monotonic, gap-free, per shard
+        assert all(
+            lin["batch_age_ms"] > 0 for lin in loader.recent_lineage
+        )
+        # The producer's host-local monotonic stamp never rides the wire.
+        assert all(
+            "created_mono_ns" not in lin for lin in loader.recent_lineage
+        )
+        assert loader.last_lineage["batch_seq"] == n - 1
+        for name in ("lineage_batch_age_ms", "lineage_wire_ms",
+                     "lineage_queue_wait_ms", "lineage_decode_ms"):
+            assert reg.get(name).count == n, name
+
+
+def test_lineage_field_absent_still_interops(image_dataset, service):
+    """Mixed-version loopback: a v1 client gets lineage-less frames (the
+    server gates the field on the peer's HELLO version) and still receives
+    the identical batch stream — the field is optional, not load-bearing."""
+    local = list(make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1,
+        ImageClassificationDecoder(image_size=32),
+    ))
+    loader = _loader(service)
+    original_hello = loader._hello
+
+    def v1_hello(start_step, probe=False):
+        msg = original_hello(start_step, probe)
+        msg["version"] = 1  # an old client on the wire
+        return msg
+
+    loader._hello = v1_hello
+    got = list(loader)
+    assert len(got) == len(local)
+    for a, b in zip(got, local):
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+    # No lineage was sent, none observed — and that is not an error.
+    assert len(loader.recent_lineage) == 0
+    assert loader.last_lineage is None
+
+
+def test_v2_client_downgrades_to_v1_server():
+    """New-client -> old-server interop: a v1 server's handshake predates
+    range negotiation and rejects any HELLO version but its own. The client
+    must re-offer MIN_PROTOCOL_VERSION and succeed — and keep speaking the
+    negotiated version on later reconnects instead of re-tripping the
+    mismatch on every drop."""
+    import threading
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+    offered = []
+
+    def strict_v1_server():  # the committed v1 equality check, verbatim
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return  # listener closed: test over
+            try:
+                _, req = P.recv_msg(conn)
+                offered.append(req["version"])
+                if req["version"] != 1:
+                    P.send_msg(conn, P.MSG_ERROR, {"message": (
+                        "protocol version mismatch: server 1, "
+                        f"client {req['version']}")})
+                else:
+                    P.send_msg(conn, P.MSG_HELLO_OK,
+                               {"version": 1, "num_steps": 7,
+                                "start_step": 0})
+            finally:
+                conn.close()
+
+    threading.Thread(target=strict_v1_server, daemon=True).start()
+    try:
+        # connect_retries=1: the downgrade redial is negotiation, not a
+        # failed attempt, so even a single-attempt client must get through.
+        loader = RemoteLoader(f"127.0.0.1:{port}", 16, 0, 1,
+                              connect_retries=1, backoff_s=0.01,
+                              timeout_s=5.0)
+        assert len(loader) == 7  # probe handshake, post-downgrade
+        assert offered == [P.PROTOCOL_VERSION, P.MIN_PROTOCOL_VERSION]
+        loader._num_steps = None  # force a fresh probe handshake
+        assert len(loader) == 7
+        assert offered[-1] == P.MIN_PROTOCOL_VERSION  # sticky downgrade
+    finally:
+        srv.close()
+
+
+def test_v1_server_hello_ok_accepted():
+    """Range check on the server's echoed version: v1 is in-range, an
+    out-of-range or garbage version is a hard skew."""
+    assert P.version_supported(1) and P.version_supported(P.PROTOCOL_VERSION)
+    assert not P.version_supported(0)
+    assert not P.version_supported(P.PROTOCOL_VERSION + 1)
+    assert not P.version_supported("2")
+    assert not P.version_supported(None)
+    assert not P.version_supported(True)  # JSON true: bool is an int subtype
+
+
+def test_encode_batch_lineage_roundtrip_and_v1_compat():
+    batch = {"x": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    lin = {"batch_seq": 5, "created_ns": 123, "decode_ms": 1.5}
+    payload = P.encode_batch(5, batch, lineage=lin)
+    # v2 decoder sees the lineage...
+    step, out, got = P.decode_batch(payload, with_lineage=True)
+    assert step == 5 and got == lin
+    np.testing.assert_array_equal(out["x"], batch["x"])
+    # ...a v1-style decode (no with_lineage) ignores the extra meta key...
+    step, out = P.decode_batch(payload)
+    assert step == 5
+    np.testing.assert_array_equal(out["x"], batch["x"])
+    # ...and a lineage-less frame reads as None, not an error.
+    assert P.decode_batch(P.encode_batch(5, batch), with_lineage=True)[2] is None
+
+
+def test_service_metrics_endpoint_serves_lineage_histograms(image_dataset):
+    """Acceptance: loopback service + 2-shard client pass, then /metrics
+    serves Prometheus text with _bucket/_sum/_count series for wire_ms and
+    batch_age_ms, and /healthz reports liveness."""
+    import json as _json
+    import urllib.request
+
+    svc = DataService(ServeConfig(
+        dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+        image_size=32, metrics_port=0,
+    )).start()
+    try:
+        for p in range(2):
+            list(RemoteLoader(
+                f"127.0.0.1:{svc.port}", 16, p, 2,
+                connect_retries=2, backoff_s=0.01,
+            ))
+        base = f"http://127.0.0.1:{svc.metrics_port}"
+        text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        for series in (
+            "lineage_wire_ms_bucket", "lineage_wire_ms_sum",
+            "lineage_wire_ms_count", "lineage_batch_age_ms_bucket",
+            "lineage_batch_age_ms_sum", "lineage_batch_age_ms_count",
+            "svc_decode_ms_bucket", "svc_queue_wait_ms_bucket",
+            "svc_batches_sent",
+        ):
+            assert series in text, f"missing {series}"
+        health = _json.loads(
+            urllib.request.urlopen(f"{base}/healthz").read()
+        )
+        assert health["status"] == "ok"
+        assert "active_clients" in health and "sessions" in health
+    finally:
+        svc.stop()
+
+
 # -- handshake failure modes ------------------------------------------------
 
 
@@ -213,6 +386,23 @@ def test_version_mismatch_rejected(image_dataset, service):
         msg_type, msg = P.recv_msg(sock)
         assert msg_type == P.MSG_ERROR
         assert "version" in msg["message"]
+    finally:
+        sock.close()
+
+
+def test_hello_ok_echoes_negotiated_version(image_dataset, service):
+    """The echo must be min(server, client), not the server's ceiling: a
+    future vN+1 server answering a vN client with N+1 would trip the
+    client's range check on a connection the server just accepted."""
+    sock = socket.create_connection(("127.0.0.1", service.port), timeout=5)
+    try:
+        req = P.hello(batch_size=16, process_index=0, process_count=1,
+                      probe=True)
+        req["version"] = 1  # an old client on the wire
+        P.send_msg(sock, P.MSG_HELLO, req)
+        msg_type, msg = P.recv_msg(sock)
+        assert msg_type == P.MSG_HELLO_OK
+        assert msg["version"] == 1
     finally:
         sock.close()
 
@@ -382,6 +572,7 @@ def test_train_through_service(image_dataset):
             data_service_addr=f"127.0.0.1:{svc.port}",
             num_classes=10, model_name="resnet18", image_size=32,
             batch_size=16, epochs=1, no_wandb=True, eval_at_end=False,
+            metrics_port=0,  # ephemeral trainer-side /metrics exporter
         ))
         assert np.isfinite(results["loss"])
         assert results["steps"] == 240 // 16
@@ -399,6 +590,11 @@ def test_serve_cli_parser_roundtrip():
     ])
     assert args.port == 0 and args.num_workers == 3
     assert args.queue_depth == 8 and args.image_size == 64
+    assert args.metrics_port is None  # exporter off by default
+    args = build_serve_parser().parse_args(
+        ["--dataset_path", "/d", "--metrics_port", "9464"]
+    )
+    assert args.metrics_port == 9464
 
 
 def test_train_cli_data_service_flag(monkeypatch):
@@ -409,5 +605,7 @@ def test_train_cli_data_service_flag(monkeypatch):
         cli, "train", lambda config: captured.update(config=config) or {}
     )
     cli.main(["train", "--dataset_path", "/d", "--no_wandb",
-              "--data_service", "cpu-host:8476"])
+              "--data_service", "cpu-host:8476",
+              "--metrics_port", "9465"])
     assert captured["config"].data_service_addr == "cpu-host:8476"
+    assert captured["config"].metrics_port == 9465
