@@ -71,6 +71,16 @@ def force_cpu(n_devices: int = 1) -> None:
 
     try:
         jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        # jax 0.4.x has no jax_num_cpu_devices; the XLA host-platform flag
+        # (read at first backend init) is the same knob — mirror of the
+        # cli.py --num_cpu_devices fallback.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{n_devices}"
+            ).strip()
     except RuntimeError:
         pass
     jax.config.update("jax_platforms", "cpu")

@@ -106,8 +106,22 @@ rotate_log() { # keep the campaign runnable for weeks without filling disk
     if [ -f "$f" ] && [ "$(wc -c < "$f")" -gt 1048576 ]; then
       # Bound by BYTES, not lines: XLA/HLO error dumps can put >1MB on a
       # single line, which a line-count rotation would never shrink.
-      tail -c 524288 "$f" > "$f.tmp" && mv "$f.tmp" "$f"
-      note "rotated $f (kept last 512KB)"
+      # Archive by RENAME ($LOG.1, $LOG.2, ...) instead of truncating in
+      # place: the old tail -c cut mid-line, and collect_bench_attempts.py
+      # silently skipped the torn probe records — rotation must never cost
+      # evidence, and every archive stays parseable end to end (pass the
+      # archives to collect_bench_attempts.py in order: it carries a probe
+      # split across a rotation boundary into the next log). Caveat: a
+      # writer holding an open append fd (a backgrounded stage's 2>>)
+      # keeps following the RENAMED file until it reopens, so one archive
+      # can exceed 1MB while that stage runs; rotate_log only fires from
+      # the probe loop, between stages, which bounds the overshoot to a
+      # single stage's output.
+      n=1
+      while [ -e "$f.$n" ]; do n=$((n + 1)); done
+      mv "$f" "$f.$n"
+      : > "$f"
+      note "rotated $f -> $f.$n (archive, no truncation)"
     fi
   done
 }

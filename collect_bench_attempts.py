@@ -59,8 +59,30 @@ def parse_log(log_path: str, batch: int) -> list[dict]:
 def parse_campaign_log(log_path: str, batch: int) -> list[dict]:
     """bench_campaign.sh probe records: a probe JSON line, then the
     campaign's ``probe N: outcome`` note (r4 logs say ``probe N/60:``)."""
+    attempts, leftover = _parse_campaign(log_path, batch, carry=None)
+    if leftover is not None:
+        attempts.append(_trailing_attempt(attempts, batch, leftover))
+    return attempts
+
+
+def _trailing_attempt(attempts: list, batch: int, probe: dict) -> dict:
+    """A probe JSON with no outcome note after it (log ended, or was
+    rotated, between the record and its note): emit it as an attempt
+    instead of dropping real evidence on the floor."""
+    a = {"batch": batch, "kind": "campaign_probe",
+         "attempt": (attempts[-1]["attempt"] + 1) if attempts else 1,
+         "outcome": "in_progress_at_log_end"}
+    _merge_probe(a, probe)
+    return a
+
+
+def _parse_campaign(log_path: str, batch: int, carry):
+    """One log's campaign attempts plus the trailing unconsumed probe (for
+    the caller to thread into the NEXT log — rotation can split a probe's
+    JSON and its outcome note across two files). ``carry`` is the previous
+    log's leftover probe."""
     attempts = []
-    last_probe = None
+    last_probe = carry
     for line in open(log_path, errors="replace"):
         line = line.strip()
         if line.startswith("{"):
@@ -87,21 +109,34 @@ def parse_campaign_log(log_path: str, batch: int) -> list[dict]:
         else:
             a["outcome"] = msg[:120]
         if last_probe is not None:
-            a["stage"] = last_probe.get("stage")
-            if last_probe.get("elapsed_s") is not None:
-                a["elapsed_s"] = last_probe["elapsed_s"]
-            if last_probe.get("error"):
-                a["error"] = str(last_probe["error"])[:200]
+            _merge_probe(a, last_probe)
             last_probe = None
         attempts.append(a)
-    return attempts
+    return attempts, last_probe
+
+
+def _merge_probe(attempt: dict, probe: dict) -> None:
+    """Fold a probe JSON's fields into its attempt record — only the keys
+    the probe actually carries (the old unconditional ``stage`` copy wrote
+    ``stage: null`` into every attempt whose probe predates that field)."""
+    if probe.get("stage") is not None:
+        attempt["stage"] = probe["stage"]
+    if probe.get("elapsed_s") is not None:
+        attempt["elapsed_s"] = probe["elapsed_s"]
+    if probe.get("error"):
+        attempt["error"] = str(probe["error"])[:200]
 
 
 def parse(log_paths: list[str], note: str | None = None) -> dict:
     attempts = []
+    carry = None  # probe split across a rotation boundary rides to the
+    # next log in command-line order, so it is counted exactly once
     for batch, path in enumerate(log_paths, start=1):
         attempts.extend(parse_log(path, batch))
-        attempts.extend(parse_campaign_log(path, batch))
+        campaign, carry = _parse_campaign(path, batch, carry)
+        attempts.extend(campaign)
+    if carry is not None:
+        attempts.append(_trailing_attempt(attempts, len(log_paths), carry))
     out = {
         "metric": "bench_claim_attempts",
         "attempts": attempts,

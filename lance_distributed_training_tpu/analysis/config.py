@@ -54,6 +54,22 @@ class CheckConfig:
             "lance_distributed_training_tpu/service/*",
             "lance_distributed_training_tpu/data/pipeline.py",
             "lance_distributed_training_tpu/data/workers.py",
+            "lance_distributed_training_tpu/data/buffers.py",
+        ]
+    )
+    # LDT701: the hot-path modules where materialising copies
+    # (.to_pylist(), bytes(view[...])) undo the zero-copy batch plane.
+    hot_paths: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "lance_distributed_training_tpu/data/decode.py",
+            "lance_distributed_training_tpu/data/pipeline.py",
+            "lance_distributed_training_tpu/data/workers.py",
+            "lance_distributed_training_tpu/data/buffers.py",
+            "lance_distributed_training_tpu/data/folder.py",
+            "lance_distributed_training_tpu/native/jpeg.py",
+            "lance_distributed_training_tpu/service/protocol.py",
+            "lance_distributed_training_tpu/service/server.py",
+            "lance_distributed_training_tpu/service/client.py",
         ]
     )
 
@@ -92,6 +108,7 @@ def load_config(root: str) -> CheckConfig:
         "queue-paths": "queue_paths",
         "protocol-module": "protocol_module",
         "obs-paths": "obs_paths",
+        "hot-paths": "hot_paths",
     }
     for key, attr in mapping.items():
         if key in section:

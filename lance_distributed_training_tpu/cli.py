@@ -81,6 +81,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--grad_accum", type=int, default=1,
                    help=">1: accumulate N micro-batches per optimizer update")
     p.add_argument("--num_workers", type=int, default=0)
+    p.add_argument("--no_shm_workers", action="store_true",
+                   help="worker-pool IPC falls back to pickling decoded "
+                        "batches instead of shared-memory ring slots "
+                        "(A/B control arm; shm is the default)")
+    p.add_argument("--no_buffer_pool", action="store_true",
+                   help="disable the recycled decode/receive buffer pool — "
+                        "every batch faults a fresh allocation (pre-r6 "
+                        "behavior; bufpool_* metrics stay at zero)")
     p.add_argument("--data_service", type=str, default=None, metavar="HOST:PORT",
                    help="stream decoded batches from a running `ldt "
                         "serve-data` service instead of decoding locally "
@@ -213,6 +221,12 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_workers", type=int, default=0,
                    help=">0: decode in N spawned worker processes (size to "
                         "this host's cores)")
+    p.add_argument("--no_shm_workers", action="store_true",
+                   help="worker-pool IPC falls back to pickling decoded "
+                        "batches instead of shared-memory ring slots")
+    p.add_argument("--no_buffer_pool", action="store_true",
+                   help="disable the recycled decode-buffer pool (every "
+                        "batch faults a fresh allocation)")
     p.add_argument("--queue_depth", type=int, default=4,
                    help="bounded per-client batch queue (backpressure)")
     p.add_argument("--handshake_timeout_s", type=float, default=30.0,
@@ -248,6 +262,8 @@ def serve_main(argv=None) -> dict:
         task_type=args.task_type,
         image_size=args.image_size,
         num_workers=args.num_workers,
+        shm_workers=not args.no_shm_workers,
+        buffer_pool=not args.no_buffer_pool,
         queue_depth=args.queue_depth,
         handshake_timeout_s=args.handshake_timeout_s,
         read_retries=args.read_retries,
@@ -372,6 +388,8 @@ def main(argv=None) -> dict:
         grad_accum=args.grad_accum,
         fsdp=args.fsdp,
         num_workers=args.num_workers,
+        shm_workers=not args.no_shm_workers,
+        buffer_pool=not args.no_buffer_pool,
         data_service_addr=args.data_service,
         no_ddp=args.no_ddp,
         no_wandb=args.no_wandb,

@@ -62,11 +62,17 @@ class ImageClassificationDecoder:
         image_column: str = "image",
         label_column: Optional[str] = "label",
         use_native: bool = True,
+        buffer_pool=None,
     ):
         self.image_size = image_size
         self.image_column = image_column
         self.label_column = label_column
         self.use_native = use_native
+        # Optional data.buffers.BufferPool: decode writes into warm,
+        # recycled pages (out=) instead of faulting a fresh np.empty per
+        # batch. The pipeline that consumes the batch owns the release
+        # (after device_put dispatch / after yield).
+        self.buffer_pool = buffer_pool
         self._bind_native()
 
     @property
@@ -102,6 +108,10 @@ class ImageClassificationDecoder:
         state = dict(self.__dict__)
         state["_native"] = None
         state["_native_arrow"] = None
+        # A BufferPool holds locks and process-local pages — meaningless
+        # across the process boundary. Workers re-bind their own
+        # (data/workers._init_worker).
+        state["buffer_pool"] = None
         return state
 
     def __setstate__(self, state):
@@ -123,10 +133,20 @@ class ImageClassificationDecoder:
             img = img.resize((self.image_size, self.image_size), Image.BILINEAR)
         return np.asarray(img, dtype=np.uint8)
 
+    def _lease_out(self, n: int) -> Optional[np.ndarray]:
+        """A pooled ``[n, S, S, 3] u8`` output page, or ``None`` when no
+        pool is bound (fresh-alloc path) or the batch is empty."""
+        if self.buffer_pool is None or n == 0:
+            return None
+        return self.buffer_pool.lease(
+            (n, self.image_size, self.image_size, 3), np.uint8
+        )
+
     def decode_payloads(self, payloads: list[bytes]) -> np.ndarray:
         """JPEG byte strings → ``[N, S, S, 3] uint8`` (native path if built)."""
+        out = self._lease_out(len(payloads))
         if self._native is not None:
-            images, failed = self._native(payloads, self.image_size)
+            images, failed = self._native(payloads, self.image_size, out=out)
             if failed.any():
                 # Corrupt-for-libjpeg rows: retry via the tolerant PIL path.
                 for i in np.nonzero(failed)[0]:
@@ -136,6 +156,8 @@ class ImageClassificationDecoder:
             images = list(_pool().map(self._decode_one, payloads))
         else:
             images = [self._decode_one(p) for p in payloads]
+        if out is not None:
+            return np.stack(images, out=out)
         return np.stack(images)
 
     def decode_column(self, col) -> np.ndarray:
@@ -151,7 +173,9 @@ class ImageClassificationDecoder:
         if self._native_arrow is not None and (
             pa.types.is_binary(col.type) or pa.types.is_large_binary(col.type)
         ):
-            images, failed = self._native_arrow(col, self.image_size)
+            images, failed = self._native_arrow(
+                col, self.image_size, out=self._lease_out(len(col))
+            )
             if failed.any():
                 # Corrupt-for-libjpeg rows: tolerant PIL retry, row by row.
                 for i in np.nonzero(failed)[0]:
@@ -184,12 +208,21 @@ class ImageTextDecoder:
     (the BASELINE "LAION-subset image+caption → CLIP" config). Images via the
     native/PIL path, token columns zero-copy via :func:`numeric_decoder`."""
 
-    def __init__(self, image_size: int = 224, image_column: str = "image"):
+    def __init__(self, image_size: int = 224, image_column: str = "image",
+                 buffer_pool=None):
         self._image = ImageClassificationDecoder(
             image_size=image_size, image_column=image_column,
-            label_column=None,
+            label_column=None, buffer_pool=buffer_pool,
         )
         self.image_column = image_column
+
+    @property
+    def buffer_pool(self):
+        return self._image.buffer_pool
+
+    @buffer_pool.setter
+    def buffer_pool(self, pool) -> None:
+        self._image.buffer_pool = pool
 
     def __call__(
         self, batch: Union[pa.RecordBatch, pa.Table]
@@ -206,17 +239,24 @@ class ImageTextDecoder:
         return out
 
 
-def decoder_for_task(task_type: str, image_size: int = 224):
+def decoder_for_task(task_type: str, image_size: int = 224,
+                     buffer_pool=None):
     """THE task-type → decode-hook dispatch, shared by the trainer and the
     data-service server. Keeping it in one place is what upholds the
     service's bit-identical-batches guarantee: a decoder change that only
-    landed on one side would silently train on different tensors."""
+    landed on one side would silently train on different tensors.
+    ``buffer_pool`` (data/buffers.BufferPool) makes the image decoders
+    write into recycled pages; output values are bit-identical either way
+    (the guarantee extends to the buffer plane — tests pin it)."""
     if task_type == "classification":
-        return ImageClassificationDecoder(image_size=image_size)
+        return ImageClassificationDecoder(
+            image_size=image_size, buffer_pool=buffer_pool
+        )
     if task_type in ("masked_lm", "causal_lm"):
-        return numeric_decoder
+        return numeric_decoder  # zero-copy Arrow→numpy: nothing to pool
     if task_type == "contrastive":
-        return ImageTextDecoder(image_size=image_size)
+        return ImageTextDecoder(image_size=image_size,
+                                buffer_pool=buffer_pool)
     raise ValueError(f"Invalid task type: {task_type}")
 
 

@@ -76,6 +76,7 @@ class FolderDataPipeline:
         prefetch: int = 2,
         workers=None,
         producers: int = 1,
+        buffer_pool=None,
     ):
         self.samples, self.classes = _folder_samples(root)
         if not self.samples:
@@ -97,6 +98,7 @@ class FolderDataPipeline:
         self.prefetch = prefetch
         self.workers = workers
         self.producers = producers
+        self.buffer_pool = buffer_pool
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -149,5 +151,6 @@ class FolderDataPipeline:
             read_fn=lambda _ds, idx: self._read(idx),
             workers=self.workers,
             producers=self.producers,
+            buffer_pool=self.buffer_pool,
         )
         return iter(pipe)

@@ -120,6 +120,7 @@ class DataPipeline:
         read_fn: Callable[[Dataset, object], pa.Table] = _range_read,
         workers=None,
         producers: int = 1,
+        buffer_pool=None,
     ):
         self.dataset = dataset
         self.plan = list(plan)
@@ -129,10 +130,35 @@ class DataPipeline:
         self.read_fn = read_fn
         self.workers = workers
         self.producers = max(1, producers)
+        # Buffer plane (data/buffers.py): the pool the decoder leased its
+        # output pages from (and the WorkerPool its copy-out pages). This
+        # pipeline owns the RELEASE side: leases go back after device_put
+        # dispatch (the H2D copy is enqueued; the pool's refcount guard
+        # protects aliased/in-flight buffers) or, for host-batch consumers,
+        # after the yield returns. Falls back to the decoder's own pool so
+        # direct constructions recycle too.
+        self.buffer_pool = (
+            buffer_pool if buffer_pool is not None
+            else getattr(decode_fn, "buffer_pool", None)
+        )
         # Telemetry: batches are stamped at creation (obs.lineage) and the
         # consumer closes the loop into pipeline_decode_ms /
         # pipeline_batch_age_ms histograms on the process registry.
         self.registry = default_registry()
+
+    def _release_host(self, batch) -> None:
+        if self.buffer_pool is not None:
+            self.buffer_pool.release_batch(batch)
+
+    def _release_drained(self, item) -> None:
+        """Teardown drains discard queued (lineage, batch) items — return
+        their pool leases so an early-terminated iteration (exception,
+        abandoned bench/test loop) recycles instead of relying on GC."""
+        if (
+            self.buffer_pool is not None
+            and isinstance(item, tuple) and len(item) == 2
+        ):
+            self.buffer_pool.release_batch(item[1])
 
     def __len__(self) -> int:
         return len(self.plan)
@@ -215,17 +241,28 @@ class DataPipeline:
                 # Close the loop: creation→pickup age (prefetch-queue dwell
                 # + any consumer lag) and the stamped decode duration.
                 observe_local_lineage(self.registry, lineage)
+                host = batch
                 if self.device_put_fn is not None:
                     # device_put on the consumer thread: enqueues an async H2D
                     # DMA; the next decode proceeds in the producer meanwhile.
-                    batch = self.device_put_fn(batch)
+                    batch = self.device_put_fn(host)
+                    # H2D dispatched: the pooled pages go back now (the
+                    # pool recycles only once jax drops its reference).
+                    self._release_host(host)
+                    host = None
                 yield batch
+                if host is not None:
+                    # Host-batch consumer (loader-only benches, tests): the
+                    # yield returned, the consumer had its turn — release;
+                    # any reference it kept defers recycling, not safety.
+                    self._release_host(host)
         finally:
             stop.set()
-            # Drain so the producer's blocked put() can observe the stop flag.
+            # Drain so the producer's blocked put() can observe the stop flag
+            # (releasing drained batches' pool leases as they go by).
             while producer.is_alive():
                 try:
-                    q.get_nowait()
+                    self._release_drained(q.get_nowait())
                 except queue.Empty:
                     producer.join(timeout=0.1)
 
@@ -263,7 +300,14 @@ class DataPipeline:
                             self.read_fn(self.dataset, item)
                         )
                         if self.device_put_fn is not None:
-                            out = self.device_put_fn(out)
+                            host = out
+                            out = self.device_put_fn(host)
+                            # Leases return in the producer here — same
+                            # thread that dispatched the H2D copy, so the
+                            # page is back in the pool before this thread's
+                            # next decode leases one.
+                            self._release_host(host)
+                            del host
                     # decode_ms here covers decode + device_put dispatch —
                     # both run in the producer on this path.
                     decode_ms = (time.monotonic_ns() - t0) / 1e6
@@ -299,15 +343,23 @@ class DataPipeline:
                 lineage, batch = item
                 observe_local_lineage(self.registry, lineage)
                 yield batch
+                if self.device_put_fn is None:
+                    # Host-batch consumers: release after the consumer's
+                    # turn (device batches were released in the producer).
+                    self._release_host(batch)
         finally:
             stop.set()
-            # Drain so blocked put()s can observe the stop flag.
+            # Drain so blocked put()s can observe the stop flag (releasing
+            # drained host batches' pool leases; device batches were
+            # released in their producer already).
             while any(t.is_alive() for t in threads):
                 for q in queues:
                     try:
-                        q.get_nowait()
+                        item = q.get_nowait()
                     except queue.Empty:
-                        pass
+                        continue
+                    if self.device_put_fn is None:
+                        self._release_drained(item)
                 for t in threads:
                     t.join(timeout=0.05)
 
@@ -328,6 +380,7 @@ def make_train_pipeline(
     seed: int = 0,
     epoch: int = 0,
     columns: Optional[Sequence[str]] = None,
+    buffer_pool=None,
 ) -> DataPipeline:
     """Iterable-style pipeline — parity with ``get_sampler``+``get_dataset``+
     ``get_loader`` (``/root/reference/lance_iterable.py:53-72,86-88``).
@@ -364,7 +417,8 @@ def make_train_pipeline(
                          process_count, shuffle=shuffle, seed=seed, epoch=epoch)
     return DataPipeline(dataset, plan, decode_fn, device_put_fn, prefetch,
                         read_fn=_with_columns(_range_read, columns),
-                        workers=workers, producers=producers)
+                        workers=workers, producers=producers,
+                        buffer_pool=buffer_pool)
 
 
 def make_eval_pipeline(
@@ -379,6 +433,7 @@ def make_eval_pipeline(
     prefetch: int = 2,
     producers: int = 1,
     index_pool: Optional[np.ndarray] = None,
+    buffer_pool=None,
 ) -> DataPipeline:
     """Full-coverage eval loader: every row exactly once, ONE compiled shape.
 
@@ -415,7 +470,8 @@ def make_eval_pipeline(
         return out
 
     return DataPipeline(None, plan, _decode, device_put_fn, prefetch,
-                        read_fn=_read, producers=producers)
+                        read_fn=_read, producers=producers,
+                        buffer_pool=buffer_pool)
 
 
 class MapStylePipeline:
@@ -445,6 +501,7 @@ class MapStylePipeline:
         producers: int = 1,
         columns: Optional[Sequence[str]] = None,
         index_pool: Optional[np.ndarray] = None,
+        buffer_pool=None,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -459,6 +516,7 @@ class MapStylePipeline:
         self.prefetch = prefetch
         self.workers = workers
         self.producers = producers
+        self.buffer_pool = buffer_pool
         self.columns = list(columns) if columns is not None else None
         # Optional row-filter pool (Dataset.filter_indices): shard/permute
         # POSITIONS in the pool, then map back to global rows — every process
@@ -503,6 +561,7 @@ class MapStylePipeline:
                 read_fn=_with_columns(_take_read, self.columns),
                 workers=self.workers,
                 producers=self.producers,
+                buffer_pool=self.buffer_pool,
             )
         )
 

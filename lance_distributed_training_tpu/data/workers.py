@@ -22,7 +22,15 @@ When to use which decode parallelism:
   scale across cores with zero IPC cost. Best when the native path is built.
 * ``num_workers>0``: process workers — true parallelism for *Python-bound*
   decode hooks (custom ``to_tensor_fn``/``collate_fn`` plugins that hold the
-  GIL), at the cost of pickling each decoded batch across the IPC boundary.
+  GIL). With the default ``transport="shm"`` the decoded tensors cross the
+  IPC boundary through ``multiprocessing.shared_memory`` ring slots
+  (:mod:`.buffers`): the worker returns only a tiny ``(slot, shapes,
+  dtypes, offsets)`` descriptor and the consumer copies once out of the
+  mapped pages — replacing the old per-batch pickle (serialise + pipe
+  write + pipe read + deserialise ≈ four full copies of ~38 MB of decoded
+  uint8 per 512×224px batch). ``transport="pickle"`` keeps the old path
+  (the A/B control arm; also the automatic fallback when POSIX shared
+  memory is unavailable).
 """
 
 from __future__ import annotations
@@ -62,7 +70,8 @@ def folder_spec(samples: Sequence[Tuple[str, int]]) -> Tuple[str, object]:
 
 
 def _init_worker(reader_spec, decode_fn, columns=None,
-                 read_retries=1, retry_backoff_s=0.05) -> None:
+                 read_retries=1, retry_backoff_s=0.05,
+                 shm_args=None) -> None:
     global _STATE
     kind, payload = reader_spec
     if kind == "columnar":
@@ -73,7 +82,18 @@ def _init_worker(reader_spec, decode_fn, columns=None,
         reader = payload
     else:
         raise ValueError(f"unknown reader spec kind {kind!r}")
-    _STATE = (kind, reader, decode_fn, columns, read_retries, retry_backoff_s)
+    writer = None
+    if shm_args is not None:
+        from .buffers import BufferPool, ShmSlotWriter
+
+        writer = ShmSlotWriter(*shm_args)
+        # Worker-local decode pages: the decoder writes into warm pooled
+        # buffers, the slot write is one memcpy out of them, and the pages
+        # recycle immediately after (pickling never sees them).
+        if hasattr(decode_fn, "buffer_pool"):
+            decode_fn.buffer_pool = BufferPool()
+    _STATE = (kind, reader, decode_fn, columns, read_retries,
+              retry_backoff_s, writer)
 
 
 def _read_item(kind: str, reader, item, columns=None) -> pa.Table:
@@ -101,8 +121,12 @@ def _read_item(kind: str, reader, item, columns=None) -> pa.Table:
 
 
 def _run_item(item):
+    """One plan item → a tagged result: ``("shm", descriptor)`` when the
+    batch rode a shared-memory slot, ``("raw", batch)`` when it must be
+    pickled (shm off, non-dict batch, or no slot freed up in time)."""
     assert _STATE is not None, "worker not initialized"
-    kind, reader, decode_fn, columns, read_retries, backoff_s = _STATE
+    (kind, reader, decode_fn, columns, read_retries, backoff_s,
+     writer) = _STATE
     retries = max(1, read_retries)
     last = None
     for attempt in range(retries):
@@ -119,7 +143,31 @@ def _run_item(item):
         raise RuntimeError(
             f"worker read failed after {retries} attempts: {last}"
         ) from last
-    return decode_fn(table)
+    batch = decode_fn(table)
+    if writer is not None and isinstance(batch, dict):
+        desc = writer.write_batch(batch)
+        pool = getattr(decode_fn, "buffer_pool", None)
+        if pool is not None:
+            # Recycle the decode pages either way: after a slot write they
+            # are free immediately; on the pickle fallback the executor's
+            # return pickling still holds the dict, so the refcount guard
+            # defers the actual reuse until that copy is done.
+            pool.release_batch(batch)
+        if desc is not None:
+            return ("shm", desc)
+    return ("raw", batch)
+
+
+def _teardown_pool(executor, ring, num_workers: int) -> None:
+    """Shutdown body shared by :meth:`WorkerPool.shutdown` and the GC-time
+    finalizer. Order matters: poison the slot queue FIRST so a worker
+    blocked waiting for a free slot wakes and finishes (executor shutdown
+    joins workers), then unlink the segments."""
+    if ring is not None:
+        ring.poison(num_workers)
+    executor.shutdown(wait=True, cancel_futures=True)
+    if ring is not None:
+        ring.cleanup()
 
 
 class WorkerPool:
@@ -138,33 +186,72 @@ class WorkerPool:
         columns: Optional[Sequence[str]] = None,
         read_retries: int = 1,
         retry_backoff_s: float = 0.05,
+        transport: str = "shm",
+        buffer_pool=None,
+        shm_slots: int = 0,
+        shm_acquire_timeout_s: float = 10.0,
     ):
         """``read_retries > 1`` retries transient in-worker read failures
         (OSError) with exponential backoff — the data-service server passes
-        its retry policy through so remote streams survive storage blips."""
+        its retry policy through so remote streams survive storage blips.
+
+        ``transport="shm"`` (default) moves decoded batches through
+        shared-memory ring slots (:mod:`.buffers`) instead of pickling
+        them; ``"pickle"`` is the legacy path (and the automatic fallback
+        when POSIX shm is unavailable). ``buffer_pool`` receives the
+        consumer-side copies so pages recycle across batches; ``shm_slots``
+        sizes the ring (default ``2 × num_workers`` — one slot per
+        in-flight item at imap's default window)."""
         if num_workers < 1:
             raise ValueError("WorkerPool needs num_workers >= 1")
+        if transport not in ("shm", "pickle"):
+            raise ValueError(
+                f"transport must be 'shm' or 'pickle', got {transport!r}"
+            )
         self.num_workers = num_workers
         self.columns = list(columns) if columns is not None else None
+        self.buffer_pool = buffer_pool
+        ctx = mp.get_context("spawn")
+        self._ring = None
+        if transport == "shm":
+            from .buffers import ShmRing, shm_available
+
+            if shm_available():
+                self._ring = ShmRing(
+                    shm_slots or 2 * num_workers, ctx,
+                    acquire_timeout_s=shm_acquire_timeout_s,
+                )
+            else:
+                import warnings
+
+                warnings.warn(
+                    "POSIX shared memory unavailable — WorkerPool falling "
+                    "back to the pickle transport (every decoded batch is "
+                    "serialised across the IPC boundary)",
+                    stacklevel=2,
+                )
+        self.transport = "shm" if self._ring is not None else "pickle"
+        shm_args = self._ring.writer_args() if self._ring is not None else None
         # Spawn, not fork: fork would inherit locks/ctypes handles mid-state —
         # the exact hazard upstream's SafeLanceDataset exists to avoid.
+        # (shm_args carries an mp.Queue: initargs travel as spawn-time
+        # Process arguments, the one context where that pickle is legal.)
         self._pool = ProcessPoolExecutor(
             max_workers=num_workers,
-            mp_context=mp.get_context("spawn"),
+            mp_context=ctx,
             initializer=_init_worker,
             initargs=(reader_spec, decode_fn,
                       list(columns) if columns is not None else None,
-                      read_retries, retry_backoff_s),
+                      read_retries, retry_backoff_s, shm_args),
         )
         # Leak guard: if the owning trainer crashes (or simply drops the
         # pool without shutdown()), the finalizer still tears the executor
-        # down at GC / interpreter exit, so spawned decode processes never
-        # outlive their parent as orphans. Registered against the executor
-        # object directly — a finalizer closing over `self` would keep the
-        # pool alive forever.
+        # down at GC / interpreter exit — spawned decode processes never
+        # outlive their parent as orphans and shm slots never outlive the
+        # pool. Registered against the executor/ring objects directly — a
+        # finalizer closing over `self` would keep the pool alive forever.
         self._finalizer = weakref.finalize(
-            self, ProcessPoolExecutor.shutdown, self._pool,
-            wait=True, cancel_futures=True,
+            self, _teardown_pool, self._pool, self._ring, num_workers,
         )
 
     @property
@@ -195,7 +282,7 @@ class WorkerPool:
             t0 = time.monotonic_ns()
             out = fut.result()
             wait_hist.observe((time.monotonic_ns() - t0) / 1e6)
-            return out
+            return self._unwrap(out)
 
         it = iter(items)
         pending: deque = deque()
@@ -208,7 +295,36 @@ class WorkerPool:
                 yield _result(pending.popleft())
         finally:
             for fut in pending:
-                fut.cancel()
+                # Cancel what hasn't started; running/done futures may hold
+                # shm slot tokens — reclaim them (non-blocking: the pool is
+                # persistent across epochs, so a lost token would shrink
+                # the ring forever; a blocking wait here would stall
+                # generator close behind in-flight decodes).
+                if not fut.cancel() and self._ring is not None:
+                    fut.add_done_callback(self._reclaim_slot)
+
+    def _unwrap(self, out):
+        """Tagged worker result → batch dict (shm read + slot ack, or the
+        pickled payload on the fallback path)."""
+        if isinstance(out, tuple) and len(out) == 2 and out[0] == "shm":
+            return self._ring.read_batch(out[1], self.buffer_pool)
+        if isinstance(out, tuple) and len(out) == 2 and out[0] == "raw":
+            if self._ring is not None:
+                self._ring.count_fallback()
+            return out[1]
+        return out  # pre-tag worker build (defensive)
+
+    def _reclaim_slot(self, fut) -> None:
+        """Done-callback for abandoned in-flight futures: return the shm
+        token their descriptor holds. Runs on the executor's collector
+        thread the moment the result lands (immediately for already-done
+        futures); release_token is a no-op after ring cleanup."""
+        try:
+            out = fut.result(timeout=0)
+        except Exception:
+            return  # worker error/cancel: shutdown's cleanup unlinks slots
+        if isinstance(out, tuple) and len(out) == 2 and out[0] == "shm":
+            self._ring.release_token(out[1])
 
     def shutdown(self) -> None:
         # wait=True: join the workers — abandoning spawn children mid-task

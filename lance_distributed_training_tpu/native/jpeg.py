@@ -125,6 +125,26 @@ def native_available() -> bool:
     return _load() is not None
 
 
+def _check_out(out: np.ndarray, n: int, out_size: int) -> np.ndarray:
+    """Validate a caller-supplied output buffer (the pooled-page path,
+    ``data/buffers.py``) before handing its pointer to C. The decoder
+    writes ``n*out_size*out_size*3`` bytes unconditionally — a wrong shape,
+    dtype or a non-contiguous view would be silent out-of-bounds writes."""
+    expected = (n, out_size, out_size, 3)
+    if out.dtype != np.uint8:
+        raise ValueError(f"out buffer must be uint8, got {out.dtype}")
+    if tuple(out.shape) != expected:
+        raise ValueError(
+            f"out buffer shape {tuple(out.shape)} != required {expected}"
+        )
+    if not out.flags["C_CONTIGUOUS"] or not out.flags["WRITEABLE"]:
+        raise ValueError(
+            "out buffer must be C-contiguous and writeable (pass a whole "
+            "pooled page, not a view)"
+        )
+    return out
+
+
 def batch_decode_jpeg(
     payloads: Sequence[bytes],
     out_size: int,
@@ -143,6 +163,8 @@ def batch_decode_jpeg(
     n = len(payloads)
     if out is None:
         out = np.empty((n, out_size, out_size, 3), dtype=np.uint8)
+    else:
+        _check_out(out, n, out_size)
     if n == 0:
         return out, np.zeros(0, np.uint8)
     srcs = (ctypes.c_char_p * n)(*payloads)
@@ -179,6 +201,8 @@ def batch_decode_jpeg_arrow(
     n = len(binary_array)
     if out is None:
         out = np.empty((n, out_size, out_size, 3), dtype=np.uint8)
+    else:
+        _check_out(out, n, out_size)
     if n == 0:
         return out, np.zeros(0, np.uint8)
     import pyarrow as pa

@@ -65,6 +65,7 @@ class RemoteLoader:
         task_type: Optional[str] = None,
         image_size: Optional[int] = None,
         registry: Optional[MetricsRegistry] = None,
+        buffer_pool=None,
     ):
         host, sep, port = addr.rpartition(":")
         if not sep or not port.isdigit():
@@ -91,6 +92,11 @@ class RemoteLoader:
         self.image_size = image_size
         self.registry = registry if registry is not None else default_registry()
         self.counters = ServiceCounters(registry=self.registry)
+        # Buffer plane: received tensors are copied into recycled pool
+        # pages (decode_batch(pool=...)) instead of fresh allocations; the
+        # consumer loop releases each batch's leases after device_put
+        # dispatch (or after its yield returns for host-batch callers).
+        self.buffer_pool = buffer_pool
         # Lineage loop closure: every v2 batch frame's stamps, merged with
         # the client-computed ages (batch_age_ms / wire_ms) — histograms go
         # to the registry, the raw recent window here for tests/debugging.
@@ -244,6 +250,10 @@ class RemoteLoader:
             self.epoch = epoch
             self._num_steps = None
 
+    def _release(self, batch) -> None:
+        if self.buffer_pool is not None:
+            self.buffer_pool.release_batch(batch)
+
     # -- iteration ---------------------------------------------------------
 
     def _receive(self, q: "queue.Queue", stop: threading.Event) -> None:
@@ -254,9 +264,13 @@ class RemoteLoader:
         try:
             sock, _ = self._connect(next_step, stop=stop)
             self._conn = sock
+            # Reusable receive buffer (FrameReader): every frame recv_into's
+            # the same pages; decode_batch copies out (into pool leases)
+            # before the next receive reuses them.
+            reader = P.FrameReader(sock)
             while not stop.is_set():
                 try:
-                    msg_type, payload = P.recv_msg(sock)
+                    msg_type, payload = reader.recv_msg()
                 except (ConnectionError, OSError) as exc:
                     if stop.is_set():
                         return
@@ -270,6 +284,7 @@ class RemoteLoader:
                         pass
                     sock, _ = self._connect(next_step, stop=stop)
                     self._conn = sock
+                    reader = P.FrameReader(sock)
                     continue
                 if msg_type == P.MSG_BATCH:
                     # Arrival stamp BEFORE deserialisation: wire_ms must
@@ -279,7 +294,8 @@ class RemoteLoader:
                     recv_ns = time.time_ns()
                     with span("client.decode", step=next_step):
                         step, batch, lineage = P.decode_batch(
-                            payload["raw"], with_lineage=True
+                            payload["raw"], with_lineage=True,
+                            pool=self.buffer_pool,
                         )
                     if step != next_step:
                         raise P.ProtocolError(
@@ -346,9 +362,17 @@ class RemoteLoader:
                     return
                 if isinstance(item, BaseException):
                     raise item
+                host = item
                 if self.device_put_fn is not None:
-                    item = self.device_put_fn(item)
+                    item = self.device_put_fn(host)
+                    # H2D dispatched: pooled pages go back (the pool's
+                    # refcount guard covers aliased / in-flight buffers).
+                    self._release(host)
+                    host = None
                 yield item
+                if host is not None:
+                    # Host-batch consumers: release after their turn.
+                    self._release(host)
         finally:
             stop.set()
             conn = self._conn
@@ -361,6 +385,8 @@ class RemoteLoader:
                     pass
             while receiver.is_alive():
                 try:
-                    q.get_nowait()
+                    # Drained items are undelivered host batches — return
+                    # their pool leases on the way out.
+                    self._release(q.get_nowait())
                 except queue.Empty:
                     receiver.join(timeout=0.1)

@@ -66,9 +66,11 @@ __all__ = [
     "recv_msg",
     "encode_batch",
     "encode_tensors",
+    "tensor_views",
     "encode_batch_meta",
     "send_batch_frame",
     "decode_batch",
+    "FrameReader",
     "ProtocolError",
 ]
 
@@ -211,10 +213,11 @@ def encode_batch(step: int, batch: dict,
 def encode_tensors(batch: dict) -> Tuple[list, bytes]:
     """Serialise a host batch's arrays → ``(tensor_metas, body_bytes)``.
 
-    This is the expensive half of :func:`encode_batch` (the multi-MB join
-    copy). Split out so a producer can pay it off the send thread, leaving
-    only the small stamp-carrying meta (:func:`encode_batch_meta`) to build
-    at send time — otherwise encode CPU masquerades as wire latency.
+    Legacy form: the ``b"".join`` is one full extra copy of the batch. The
+    hot path uses :func:`tensor_views` + the vectored
+    :func:`send_batch_frame` instead, which moves the same wire bytes with
+    zero intermediate joins; this stays for :func:`encode_batch` (tests,
+    tools) where a single contiguous payload is the point.
     """
     metas, buffers = [], []
     for name, arr in batch.items():
@@ -222,6 +225,49 @@ def encode_tensors(batch: dict) -> Tuple[list, bytes]:
         metas.append([name, arr.dtype.str, list(arr.shape)])
         buffers.append(arr.data if arr.size else b"")
     return metas, b"".join(buffers)
+
+
+def tensor_views(batch: dict) -> Tuple[list, list]:
+    """Zero-join serialisation: ``(tensor_metas, [memoryview, ...])``.
+
+    Each view is a flat ``'B'``-cast window over the array's own buffer (the
+    view keeps the array alive), in meta order — handed to
+    :func:`send_batch_frame`, the kernel gathers them with one vectored
+    write per syscall, so a batch crosses the wire with **no** intermediate
+    ``bytes`` concatenation on the send side. Wire bytes are identical to
+    ``encode_tensors``'s joined body.
+    """
+    metas, views = [], []
+    for name, arr in batch.items():
+        arr = np.ascontiguousarray(arr)
+        metas.append([name, arr.dtype.str, list(arr.shape)])
+        if arr.size:
+            views.append(memoryview(arr).cast("B"))
+    return metas, views
+
+
+# iovec batching cap for sendmsg: far below any platform IOV_MAX (Linux
+# 1024), far above any real batch's tensor count.
+_SENDMSG_MAX_VECS = 64
+
+
+def _sendmsg_all(sock: socket.socket, views: list) -> None:
+    """``sendall`` semantics over a list of buffers via vectored
+    ``sendmsg`` — loops on partial sends, never concatenates."""
+    views = [v for v in views if v.nbytes]
+    if not hasattr(sock, "sendmsg"):  # non-POSIX socket (or a test double):
+        for v in views:  # same bytes, one write per buffer, still no join
+            sock.sendall(v)
+        return
+    while views:
+        sent = sock.sendmsg(views[:_SENDMSG_MAX_VECS])
+        i = 0
+        while i < len(views) and sent >= views[i].nbytes:
+            sent -= views[i].nbytes
+            i += 1
+        views = views[i:]
+        if views and sent:
+            views[0] = views[0][sent:]
 
 
 def encode_batch_meta(step: int, tensor_metas: list,
@@ -234,31 +280,41 @@ def encode_batch_meta(step: int, tensor_metas: list,
     return json.dumps(header).encode("utf-8")
 
 
-def send_batch_frame(sock: socket.socket, meta: bytes, body: bytes) -> int:
-    """Send one MSG_BATCH built from :func:`encode_tensors` +
-    :func:`encode_batch_meta` parts, without re-joining the body into a
-    fresh payload copy. Wire bytes are identical to
-    ``send_frame(sock, MSG_BATCH, encode_batch(...))``. Returns the payload
-    length (for bytes-sent accounting)."""
-    payload_len = _META_LEN.size + len(meta) + len(body)
+def send_batch_frame(sock: socket.socket, meta: bytes, body) -> int:
+    """Send one MSG_BATCH built from :func:`tensor_views` (or legacy
+    :func:`encode_tensors`) + :func:`encode_batch_meta` parts, without
+    re-joining the body into a fresh payload copy. ``body`` is either the
+    joined ``bytes`` or a list of memoryviews — the latter goes out as ONE
+    vectored write stream (header+meta and every tensor gathered by the
+    kernel), so the send path never materialises an intermediate payload.
+    Wire bytes are identical to ``send_frame(sock, MSG_BATCH,
+    encode_batch(...))`` either way. Returns the payload length (for
+    bytes-sent accounting)."""
+    if isinstance(body, (bytes, bytearray, memoryview)):
+        views = [memoryview(body)] if len(body) else []
+    else:
+        views = list(body)
+    body_len = sum(v.nbytes for v in views)
+    payload_len = _META_LEN.size + len(meta) + body_len
     if payload_len >= MAX_FRAME:
         raise ProtocolError(f"frame too large: {payload_len} bytes")
-    # Header + meta are small: one sendall. The body rides its own sendall,
-    # same as send_frame's bulk path.
-    sock.sendall(_HEADER.pack(payload_len, MSG_BATCH)
-                 + _META_LEN.pack(len(meta)) + meta)
-    if body:
-        sock.sendall(body)
+    head = memoryview(
+        _HEADER.pack(payload_len, MSG_BATCH) + _META_LEN.pack(len(meta)) + meta
+    )
+    _sendmsg_all(sock, [head] + views)
     return payload_len
 
 
-def decode_batch(payload, with_lineage: bool = False):
+def decode_batch(payload, with_lineage: bool = False, pool=None):
     """MSG_BATCH payload → ``(step, {name: np.ndarray})``, or with
     ``with_lineage=True`` → ``(step, batch, lineage_or_None)`` (``None``
     when the sender predates — or gated off — the v2 lineage field).
 
     Arrays are copies (the frame buffer is reused by the receive loop), each
     materialised with one ``frombuffer`` + reshape — no element-wise work.
+    With ``pool`` (a ``data.buffers.BufferPool``) the copy lands in a warm
+    recycled page instead of faulting a fresh allocation; values are
+    bit-identical either way, and the consumer owns the lease release.
     """
     view = memoryview(payload)
     if len(view) < _META_LEN.size:
@@ -279,11 +335,15 @@ def decode_batch(payload, with_lineage: bool = False):
         nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
         if len(view) < offset + nbytes:
             raise ProtocolError(f"batch frame truncated inside tensor {name!r}")
-        out[name] = (
-            np.frombuffer(view[offset : offset + nbytes], dtype=dtype)
-            .reshape(shape)
-            .copy()
-        )
+        src = np.frombuffer(
+            view[offset : offset + nbytes], dtype=dtype
+        ).reshape(shape)
+        if pool is not None and nbytes:
+            dst = pool.lease(shape, dtype)
+            np.copyto(dst, src)
+            out[name] = dst
+        else:
+            out[name] = src.copy()
         offset += nbytes
     if offset != len(view):
         raise ProtocolError(
@@ -295,6 +355,74 @@ def decode_batch(payload, with_lineage: bool = False):
             lineage if isinstance(lineage, dict) else None
         )
     return int(meta["step"]), out
+
+
+class FrameReader:
+    """Per-connection frame receiver with a reusable receive buffer.
+
+    ``recv_frame``/``recv_msg`` allocate a fresh ``bytearray`` per frame;
+    at one multi-MB batch per step per client that is a page-faulted
+    allocation on every receive. This reader owns ONE growable buffer and
+    ``recv_into``s every frame on top of it, so steady-state receives touch
+    no allocator at all.
+
+    Contract: the returned payload is a ``memoryview`` over the internal
+    buffer, valid only until the next ``recv_msg`` call — decode it (the
+    client calls :func:`decode_batch`, which copies out) before receiving
+    again. Wire semantics are byte-identical to :func:`recv_msg` (tests pin
+    the parity frame-for-frame).
+    """
+
+    def __init__(self, sock: socket.socket, initial_capacity: int = 1 << 16):
+        self.sock = sock
+        self._buf = bytearray(max(initial_capacity, _HEADER.size))
+
+    def _recv_exact_into(
+        self, view: memoryview, deadline: Optional[float] = None
+    ) -> None:
+        """Fill ``view`` completely (same EOF/deadline semantics as
+        ``_recv_exact`` — the deadline bounds the WHOLE read)."""
+        sock = self.sock
+        got, n = 0, view.nbytes
+        while got < n:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise socket.timeout("frame-read deadline exceeded")
+                sock.settimeout(remaining)
+            r = sock.recv_into(view[got:], n - got)
+            if r == 0:
+                raise ConnectionError("peer closed mid-frame")
+            got += r
+
+    def recv_msg(
+        self, deadline: Optional[float] = None
+    ) -> Tuple[int, dict]:
+        """Same contract as module-level :func:`recv_msg`, but the batch
+        payload under ``{"raw": ...}`` is a view into the reusable buffer
+        (valid until the next call)."""
+        head = memoryview(self._buf)[: _HEADER.size]
+        self._recv_exact_into(head, deadline)
+        length, msg_type = _HEADER.unpack(head)
+        if length >= MAX_FRAME:
+            raise ProtocolError(f"frame too large: {length} bytes")
+        if length > len(self._buf):
+            # Grow geometrically: a few early resizes, then a stable page
+            # set for the rest of the stream.
+            self._buf = bytearray(max(length, 2 * len(self._buf)))
+        payload = memoryview(self._buf)[:length]
+        self._recv_exact_into(payload, deadline)
+        if msg_type == MSG_BATCH:
+            return msg_type, {"raw": payload}
+        try:
+            out = json.loads(bytes(payload).decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as exc:
+            raise ProtocolError(
+                f"undecodable control frame type {msg_type}: {exc}"
+            )
+        if not isinstance(out, dict):
+            raise ProtocolError(f"control frame type {msg_type} is not a dict")
+        return msg_type, out
 
 
 def hello(

@@ -69,6 +69,13 @@ class ServeConfig:
     task_type: str = "classification"  # selects the decode hook
     image_size: int = 224
     num_workers: int = 0  # >0: decode in N spawned worker processes
+    shm_workers: bool = True  # worker batches ride shared-memory ring slots
+    # (data/buffers.py) instead of being pickled across the IPC boundary;
+    # False = legacy pickle transport (A/B control; auto-fallback when
+    # POSIX shm is unavailable)
+    buffer_pool: bool = True  # recycle decode/copy-out pages through the
+    # process BufferPool (bufpool_* metrics show hit/miss on /metrics);
+    # False = fault a fresh allocation per batch (the pre-r6 behavior)
     queue_depth: int = 4  # per-client bounded batch queue
     handshake_timeout_s: float = 30.0  # HELLO recv deadline per connection
     read_retries: int = 3  # dataset-read attempts before ERROR
@@ -174,9 +181,15 @@ class _ClientSession:
                 svc.counters.add("resumes")
             self._stream(plan, start, req)
         except (ConnectionError, OSError, P.ProtocolError) as exc:
-            # Client vanished or spoke garbage — log via counters, move on.
+            # Client vanished or spoke garbage — count it, move on. Quiet
+            # when the session (or the whole service) is already tearing
+            # down: the ack-reader noticing the drop makes the sender's
+            # subsequent EPIPE expected cleanup, not an event — and the
+            # stray print lands at unpredictable times from a daemon
+            # thread (mid-shutdown, between tests).
             svc.counters.add("client_errors")
-            svc._log(f"client {self.peer}: {exc}")
+            if not (self._stop.is_set() or svc._stopped.is_set()):
+                svc._log(f"client {self.peer}: {exc}")
         except Exception as exc:  # decode/plan errors: tell the client
             svc.counters.add("server_errors")
             svc._log(f"client {self.peer}: {exc!r}")
@@ -231,7 +244,7 @@ class _ClientSession:
                     return
                 if isinstance(item, BaseException):
                     raise item
-                step, metas, body, lineage, enq_ns = item
+                step, metas, views, batch, lineage, enq_ns = item
                 # Queue dwell = how long this client's consumption lagged
                 # decode; stamped HERE (not in the producer) so the value
                 # covers the whole wait and can still ride the frame.
@@ -255,17 +268,37 @@ class _ClientSession:
                     else:  # v1 peer: omit the field (bit-identical v1)
                         lineage = None
                     meta = P.encode_batch_meta(step, metas, lineage)
-                    sent = P.send_batch_frame(self.sock, meta, body)
+                    sent = P.send_batch_frame(self.sock, meta, views)
                 svc.counters.add("batches_sent")
                 svc.counters.add("bytes_sent", sent)
+                # Frame is on the wire: the views die with `item`, so the
+                # pooled decode pages can recycle into the next batch.
+                if svc.buffer_pool is not None:
+                    svc.buffer_pool.release_batch(batch)
+                del item, views, batch
         finally:
             self._stop.set()
-            # Unblock a producer waiting on a full queue so it can exit.
+            # Unblock a producer waiting on a full queue so it can exit —
+            # and RELEASE the drained batches' pool leases: a disconnect
+            # mid-epoch must return up to queue_depth decoded batches to
+            # the pool, not strand them (reconnects are routine, so this
+            # path runs often in a long-lived serve-data).
             while producer.is_alive():
                 try:
-                    self._q.get_nowait()
+                    self._release_item(self._q.get_nowait())
                 except queue.Empty:
                     producer.join(timeout=0.1)
+            while True:  # producer gone: drain whatever it left behind
+                try:
+                    self._release_item(self._q.get_nowait())
+                except queue.Empty:
+                    break
+
+    def _release_item(self, item) -> None:
+        """Give a drained sender-queue item's pooled pages back."""
+        pool = self.service.buffer_pool
+        if pool is not None and isinstance(item, tuple) and len(item) == 6:
+            pool.release_batch(item[3])
 
     def _produce(self, plan, start: int, req: dict) -> None:
         """Decode plan items [start:] into the bounded queue, in order.
@@ -298,12 +331,16 @@ class _ClientSession:
                 decode_ms = (time.monotonic_ns() - t0) / 1e6
                 svc.counters.observe("decode_ms", decode_ms)
                 lineage = make_lineage(step, decode_ms)
-                # Serialise HERE so the multi-MB body join overlaps the
-                # sender's sendall of the previous frame; only the small
-                # meta (send-time stamps) is built on the sender.
-                metas, body = P.encode_tensors(batch)
+                # Zero-join serialisation: flat views over the batch's own
+                # buffers (tensor_views) ride the queue; the sender's
+                # vectored write gathers them straight from the decode
+                # pages — no intermediate body copy anywhere. The batch
+                # dict rides along so the sender can release its pooled
+                # pages once the frame is out.
+                metas, views = P.tensor_views(batch)
                 t1 = time.perf_counter()
-                self._q.put((step, metas, body, lineage, time.monotonic_ns()))
+                self._q.put((step, metas, views, batch, lineage,
+                             time.monotonic_ns()))
                 # Producer blocked = this client consumes slower than decode.
                 svc.counters.add("queue_full_s", time.perf_counter() - t1)
                 svc.counters.gauge("queue_depth", self._q.qsize())
@@ -346,9 +383,19 @@ class DataService:
 
         self.config = config
         self.dataset = Dataset(config.dataset_path)
+        # Buffer plane: decode output pages and worker copy-out pages
+        # recycle through the process pool; the sender releases each
+        # batch's leases after its frame is on the wire.
+        self.buffer_pool = None
+        if config.buffer_pool:
+            from ..data.buffers import default_buffer_pool
+
+            self.buffer_pool = default_buffer_pool()
         # The SAME dispatch the trainer uses — the bit-identical-batches
         # guarantee depends on both sides binding one decoder implementation.
-        self.decode_fn = decoder_for_task(config.task_type, config.image_size)
+        self.decode_fn = decoder_for_task(
+            config.task_type, config.image_size, buffer_pool=self.buffer_pool
+        )
         self.counters = ServiceCounters()
         self.workers = None
         if config.num_workers > 0:
@@ -361,6 +408,8 @@ class DataService:
                 columns=getattr(self.decode_fn, "required_columns", None),
                 read_retries=config.read_retries,
                 retry_backoff_s=config.retry_backoff_s,
+                transport="shm" if config.shm_workers else "pickle",
+                buffer_pool=self.buffer_pool,
             )
         self._plans: dict = {}  # handshake params -> per-process plans
         self._plans_lock = threading.Lock()

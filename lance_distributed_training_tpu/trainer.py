@@ -111,6 +111,15 @@ class TrainConfig:
     grad_clip: float = 0.0  # >0: clip gradients by global norm
     grad_accum: int = 1  # >1: accumulate N micro-steps per optimizer update
     num_workers: int = 0  # >0: decode in N worker processes (get_safe_loader parity)
+    shm_workers: bool = True  # worker-pool batches cross the IPC boundary
+    # through shared-memory ring slots (data/buffers.py) instead of being
+    # pickled — descriptor-only returns, one copy out of the mapped pages.
+    # False = legacy pickle transport (the A/B control arm; also the
+    # automatic fallback where POSIX shm is unavailable).
+    buffer_pool: bool = True  # recycle decode / wire-receive pages through
+    # the process BufferPool: decode writes into warm leased pages and the
+    # loader returns them after device_put dispatch (bufpool_* metrics on
+    # /metrics). False = fault a fresh allocation per batch (pre-r6).
     data_service_addr: Optional[str] = None  # host:port of a running
     # `ldt serve-data` DataService: decode runs on that host's fleet and this
     # process streams plan-ordered device-ready batches (RemoteLoader) —
@@ -493,10 +502,22 @@ def evaluate(state, loader, eval_step) -> float:
     return float(num) / total if total else 0.0
 
 
+def _loader_buffer_pool(config: TrainConfig):
+    """The process BufferPool when the knob is on — shared by the decoder
+    (lease side) and every pipeline (release side), so pages recycle across
+    batches instead of faulting fresh per step."""
+    if not config.buffer_pool:
+        return None
+    from .data.buffers import default_buffer_pool
+
+    return default_buffer_pool()
+
+
 def _decoder_for(config: TrainConfig):
     from .data.decode import decoder_for_task
 
-    return decoder_for_task(config.task_type, config.image_size)
+    return decoder_for_task(config.task_type, config.image_size,
+                            buffer_pool=_loader_buffer_pool(config))
 
 
 def _make_worker_pool(config: TrainConfig, dataset):
@@ -510,14 +531,17 @@ def _make_worker_pool(config: TrainConfig, dataset):
 
     decode = _decoder_for(config)
     columns = getattr(decode, "required_columns", None)
+    transport = "shm" if config.shm_workers else "pickle"
+    pool = _loader_buffer_pool(config)
     if config.data_format == "folder":
         from .data.authoring import _folder_samples
 
         samples, _ = _folder_samples(config.dataset_path)
-        return WorkerPool(folder_spec(samples), decode, config.num_workers)
+        return WorkerPool(folder_spec(samples), decode, config.num_workers,
+                          transport=transport, buffer_pool=pool)
     return WorkerPool(
         columnar_spec(config.dataset_path), decode, config.num_workers,
-        columns=columns,
+        columns=columns, transport=transport, buffer_pool=pool,
     )
 
 
@@ -557,6 +581,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             columns=getattr(decode, "required_columns", None),
             task_type=config.task_type,
             image_size=config.image_size,
+            buffer_pool=_loader_buffer_pool(config),
         )
         if len(loader) == 0:
             raise ValueError(
@@ -589,6 +614,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             prefetch=config.prefetch,
             workers=workers,
             producers=config.producer_threads,
+            buffer_pool=_loader_buffer_pool(config),
         )
         if len(loader) == 0:
             raise ValueError("folder smaller than one global batch")
@@ -633,6 +659,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             producers=config.producer_threads,
             columns=columns,
             index_pool=index_pool,
+            buffer_pool=_loader_buffer_pool(config),
         )
     else:
         loader = make_train_pipeline(
@@ -650,6 +677,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             seed=config.seed,
             epoch=epoch,
             columns=columns,
+            buffer_pool=_loader_buffer_pool(config),
         )
     if len(loader) == 0:
         raise ValueError(
@@ -744,6 +772,7 @@ def _build_eval_loader(config: TrainConfig, dataset, mesh, index_pool=None):
         prefetch=config.prefetch,
         producers=config.producer_threads,
         index_pool=index_pool,
+        buffer_pool=_loader_buffer_pool(config),
     )
 
 

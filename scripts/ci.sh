@@ -9,7 +9,10 @@
 # --metrics_port, one loopback client pass, then fetch /metrics and
 # /healthz (the scriptable curl equivalent, stdlib-only so CI needs no
 # curl binary) and assert the Prometheus histogram series are there.
-# Stage 3 — the tier-1 verify command from ROADMAP.md, verbatim.
+# Stage 3 — buffer-plane smoke (scripts/zc_smoke.py): shm-worker loopback,
+# asserts bufpool_hit_total > 0 / shm_batches_total > 0 via /metrics and
+# zero leaked /dev/shm segments after shutdown.
+# Stage 4 — the tier-1 verify command from ROADMAP.md, verbatim.
 set -e
 cd "$(dirname "$0")/.."
 
@@ -70,6 +73,15 @@ finally:
     svc.stop()
     shutil.rmtree(tmp, ignore_errors=True)
 PY
+
+echo "== buffer-plane smoke (shm workers + pooled pages) =="
+# A serve-data with shm worker IPC, one loopback client pass, then assert
+# via /metrics that the plane actually recycled (bufpool_hit_total > 0) and
+# the batches actually rode shared memory (shm_batches_total > 0, zero
+# pickle fallbacks), and that no shm segment outlives shutdown. A real
+# script file, not a heredoc: spawn workers re-import __main__, which must
+# be an importable path.
+timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/zc_smoke.py
 
 echo "== tier-1 tests =="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -54,6 +54,48 @@ def test_parse_bench_stderr_dialect(tmp_path):
     assert attempts[2]["outcome"] == "claimed"
 
 
+def test_trailing_probe_emitted_and_rotation_split_merged(tmp_path):
+    """A probe JSON with no outcome note is evidence, not garbage: alone it
+    becomes an in_progress_at_log_end attempt; when the note landed in the
+    NEXT log (rotate_log archiving between the two lines), parse() merges
+    the pair into exactly ONE attempt with both the probe's fields and the
+    real outcome."""
+    probe_line = ('{"probe": "tpu_liveness", "ok": false, "stage": "claim", '
+                  '"elapsed_s": 240.0, "error": "hang"}\n')
+    note_line = ("[campaign 2026-07-31 20:00:00] probe 4: claim-hang "
+                 "(or killed pre-watchdog)\n")
+    archived = tmp_path / "c.log.1"
+    archived.write_text(CAMPAIGN_LOG_R5 + probe_line)
+    fresh = tmp_path / "c.log"
+    fresh.write_text(note_line)
+
+    # Single truncated log: trailing probe surfaces as its own attempt.
+    solo = parse_campaign_log(str(archived), batch=1)
+    assert solo[-1]["outcome"] == "in_progress_at_log_end"
+    assert solo[-1]["stage"] == "claim"
+
+    # Both halves in rotation order: one merged attempt, no double count.
+    out = parse([str(archived), str(fresh)], note=None)
+    probes = [a for a in out["attempts"] if a.get("kind") == "campaign_probe"]
+    assert len(probes) == 4  # 3 from CAMPAIGN_LOG_R5 + the split one
+    split = probes[-1]
+    assert split["outcome"] == "hang_claim"  # the real outcome, not in_progress
+    assert split["stage"] == "claim"  # carried across the boundary
+    assert split["elapsed_s"] == 240.0
+
+
+def test_probe_without_stage_field_sets_no_stage_key(tmp_path):
+    """Old probe records predate the stage/elapsed_s fields — attempts must
+    omit the keys, not carry stage: null."""
+    p = tmp_path / "c.log"
+    p.write_text('{"probe": "tpu_liveness", "ok": true}\n'
+                 "[campaign 2026-07-31 18:30:00] probe 1: chip healthy — "
+                 "running protocol\n")
+    (a,) = parse_campaign_log(str(p), batch=1)
+    assert a["outcome"] == "claimed"
+    assert "stage" not in a and "elapsed_s" not in a
+
+
 def test_parse_campaign_dialect_r5(tmp_path):
     p = tmp_path / "campaign.log"
     p.write_text(CAMPAIGN_LOG_R5)
