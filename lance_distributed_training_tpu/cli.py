@@ -5,11 +5,15 @@ Flag-for-flag parity with ``/root/reference/lance_iterable.py:136-146`` (plus
 ``lance_map_style.py:128-148``, and TPU knobs). Topology comes from JAX
 process discovery, not torchrun env vars (``lance_iterable.py:154-156``).
 
-Four subcommands share the ``ldt`` entry point:
+Five subcommands share the ``ldt`` entry point:
 
 * ``ldt train …`` (or bare flags, backward-compatible) — the trainer;
 * ``ldt serve-data …`` — the disaggregated input-data service: decode on
-  CPU hosts, trainers point at it with ``--data_service host:port``;
+  CPU hosts, trainers point at it with ``--data_service host:port`` (or
+  join a fleet with ``--coordinator host:port``);
+* ``ldt coordinator …`` — the fleet control plane: membership, shard
+  leases, heartbeats for N serve-data members; trainers point at it with
+  ``--coordinator host:port`` (README "Fleet");
 * ``ldt check …`` — the AST-based distributed-training lint (exits
   non-zero on new findings; see README "Static analysis");
 * ``ldt trace export …`` — convert recorded span JSONL (LDT_TRACE_PATH)
@@ -93,6 +97,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stream decoded batches from a running `ldt "
                         "serve-data` service instead of decoding locally "
                         "(disaggregated input plane; iterable columnar path)")
+    p.add_argument("--coordinator", type=str, default=None, metavar="HOST:PORT",
+                   help="stream decoded batches from an elastic fleet of "
+                        "`ldt serve-data` servers discovered via this `ldt "
+                        "coordinator` (striped across live members, failover "
+                        "at the resume cursor). Mutually exclusive with "
+                        "--data_service; NOT the jax multi-host rendezvous "
+                        "(--coordinator_address)")
     p.add_argument("--no_ddp", action="store_true",
                    help="single-device debug mode (reference --no_ddp)")
     p.add_argument("--no_wandb", action="store_true")
@@ -247,7 +258,76 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="exporter bind address (default loopback; the "
                         "endpoint is unauthenticated — 0.0.0.0 is an "
                         "explicit opt-in)")
+    p.add_argument("--coordinator", type=str, default=None,
+                   metavar="HOST:PORT",
+                   help="register with this fleet coordinator (`ldt "
+                        "coordinator`) and serve as one elastic member: "
+                        "heartbeats, shard lease, deregister on stop")
+    p.add_argument("--advertise_addr", type=str, default=None,
+                   metavar="HOST:PORT",
+                   help="the address CLIENTS dial, as registered with the "
+                        "coordinator (default: bind host + bound port, "
+                        "hostname when binding a wildcard — set explicitly "
+                        "behind NAT/containers)")
+    p.add_argument("--server_id", type=str, default=None,
+                   help="stable fleet identity (default: advertise addr + "
+                        "random suffix)")
+    p.add_argument("--heartbeat_interval_s", type=float, default=0.0,
+                   help="heartbeat period; 0 = use the coordinator's "
+                        "advertised interval")
     return p
+
+
+def build_coordinator_parser() -> argparse.ArgumentParser:
+    """``ldt coordinator`` — the fleet control plane: membership,
+    generation-numbered shard leases, heartbeat expiry. Carries no data."""
+    p = argparse.ArgumentParser(
+        prog="ldt coordinator",
+        description="Coordinate an elastic fleet of `ldt serve-data` "
+                    "servers: registration, heartbeats, shard leases, "
+                    "membership resolution for trainers",
+    )
+    p.add_argument("--host", type=str, default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8470,
+                   help="0 = pick an ephemeral port (printed at startup)")
+    p.add_argument("--heartbeat_interval_s", type=float, default=2.0,
+                   help="heartbeat period advertised to members")
+    p.add_argument("--lease_ttl_s", type=float, default=6.0,
+                   help="heartbeat silence after which a member is expired "
+                        "and its lease reassigned (keep >= 2-3 heartbeat "
+                        "intervals)")
+    p.add_argument("--handshake_timeout_s", type=float, default=10.0,
+                   help="per-connection request deadline (a silent peer is "
+                        "dropped after this)")
+    p.add_argument("--log_every_s", type=float, default=30.0,
+                   help="periodic membership line; 0 = off")
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="serve /metrics (fleet_members, "
+                        "fleet_lease_generation, fleet_rebalance_ms, ...) "
+                        "and /healthz (member table, heartbeat ages) on "
+                        "this port (0 = ephemeral; default off)")
+    p.add_argument("--metrics_host", type=str, default="127.0.0.1",
+                   help="exporter bind address (default loopback)")
+    return p
+
+
+def coordinator_main(argv=None) -> dict:
+    """``coordinator`` subcommand body — blocks until interrupted."""
+    args = build_coordinator_parser().parse_args(argv)
+    from .fleet.coordinator import Coordinator, CoordinatorConfig
+
+    coordinator = Coordinator(CoordinatorConfig(
+        host=args.host,
+        port=args.port,
+        heartbeat_interval_s=args.heartbeat_interval_s,
+        lease_ttl_s=args.lease_ttl_s,
+        handshake_timeout_s=args.handshake_timeout_s,
+        log_every_s=args.log_every_s,
+        metrics_port=args.metrics_port,
+        metrics_host=args.metrics_host,
+    ))
+    coordinator.serve_forever()
+    return coordinator.registry.snapshot()
 
 
 def serve_main(argv=None) -> dict:
@@ -270,6 +350,10 @@ def serve_main(argv=None) -> dict:
         log_every_s=args.log_every_s,
         metrics_port=args.metrics_port,
         metrics_host=args.metrics_host,
+        coordinator_addr=args.coordinator,
+        advertise_addr=args.advertise_addr,
+        server_id=args.server_id,
+        heartbeat_interval_s=args.heartbeat_interval_s,
     ))
     service.serve_forever()
     return service.counters.snapshot()
@@ -297,6 +381,10 @@ def main(argv=None) -> dict:
     # (every existing invocation keeps working).
     if argv and argv[0] == "serve-data":
         return serve_main(argv[1:])
+    if argv and argv[0] == "coordinator":
+        # The fleet control plane: membership + shard leases for N
+        # serve-data members (README "Fleet").
+        return coordinator_main(argv[1:])
     if argv and argv[0] == "check":
         # The static-analysis gate: returns an int exit status (0 = clean /
         # no new findings), not a metrics dict.
@@ -391,6 +479,7 @@ def main(argv=None) -> dict:
         shm_workers=not args.no_shm_workers,
         buffer_pool=not args.no_buffer_pool,
         data_service_addr=args.data_service,
+        coordinator_addr=args.coordinator,
         no_ddp=args.no_ddp,
         no_wandb=args.no_wandb,
         model_name=args.model_name,

@@ -1,0 +1,198 @@
+"""``FleetAgent`` — a data server's membership half.
+
+Owned by :class:`~..service.server.DataService` when
+``ServeConfig.coordinator_addr`` is set: registers the server's advertise
+address with the :class:`~.coordinator.Coordinator` at start, heartbeats on
+a daemon thread, surfaces lease changes (generation bumps) back to the
+service through ``on_lease_change``, and deregisters on stop so a graceful
+shutdown reassigns the lease immediately instead of waiting out the TTL.
+
+Failure discipline: the agent never takes the data plane down. A missing or
+crashed coordinator means retry-with-backoff forever (members keep serving
+the clients they have; discovery degrades, streams don't), and an
+``unknown fleet member`` heartbeat answer — expiry while partitioned, or a
+coordinator restart that lost the table — triggers re-registration, not an
+error.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from ..service import protocol as P
+from .coordinator import UNKNOWN_MEMBER_MARKER
+
+__all__ = ["FleetAgent"]
+
+
+class FleetAgent:
+    """Register + heartbeat one data server against a coordinator."""
+
+    def __init__(
+        self,
+        coordinator_addr: str,
+        advertise_addr: str,
+        *,
+        server_id: Optional[str] = None,
+        num_fragments: int = 0,
+        on_lease_change: Optional[Callable[[dict], None]] = None,
+        counters=None,  # a ServiceCounters (optional): fleet_* keys
+        heartbeat_interval_s: float = 0.0,  # 0 = coordinator-advertised
+        dial_timeout_s: float = 5.0,
+        backoff_s: float = 0.2,  # doubles per failure, capped at ~5s
+    ):
+        self.coordinator_host, self.coordinator_port = P.parse_hostport(
+            coordinator_addr
+        )
+        self.advertise_addr = advertise_addr
+        self.server_id = server_id or (
+            f"{advertise_addr}#{uuid.uuid4().hex[:8]}"
+        )
+        self.num_fragments = num_fragments
+        self.on_lease_change = on_lease_change
+        self.counters = counters
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.dial_timeout_s = dial_timeout_s
+        self.backoff_s = backoff_s
+        self.lease: Optional[dict] = None
+        self.generation: int = 0
+        self.registered = threading.Event()  # tests/healthz wait on this
+        self._stop = threading.Event()
+        self._paused = threading.Event()  # chaos: heartbeats held, not dead
+        self._thread: Optional[threading.Thread] = None
+
+    # -- coordinator RPC ----------------------------------------------------
+
+    def _call(self, msg_type: int, payload: dict) -> tuple:
+        """One request/reply exchange on a fresh connection — the fleet
+        control plane's whole wire contract. The reply read is
+        deadline-bounded (a wedged coordinator must not pin the heartbeat
+        thread past a dial timeout)."""
+        with socket.create_connection(
+            (self.coordinator_host, self.coordinator_port),
+            timeout=self.dial_timeout_s,
+        ) as sock:
+            P.send_msg(sock, msg_type, payload)
+            return P.recv_msg(
+                sock, deadline=time.monotonic() + self.dial_timeout_s
+            )
+
+    def _count(self, key: str) -> None:
+        if self.counters is not None:
+            self.counters.add(key)
+
+    def _apply_lease(self, reply: dict) -> None:
+        generation = int(reply.get("generation", 0))
+        lease = reply.get("lease")
+        changed = generation != self.generation
+        self.generation = generation
+        if isinstance(lease, dict):
+            self.lease = lease
+        if changed and self.on_lease_change is not None and self.lease:
+            self.on_lease_change(dict(self.lease))
+
+    def _register(self) -> bool:
+        try:
+            msg_type, reply = self._call(P.MSG_FLEET_REGISTER, {
+                "server_id": self.server_id,
+                "addr": self.advertise_addr,
+                "num_fragments": self.num_fragments,
+            })
+        except (ConnectionError, OSError, P.ProtocolError):
+            self._count("fleet_register_errors")
+            return False
+        if msg_type != P.MSG_FLEET_REGISTER_OK:
+            self._count("fleet_register_errors")
+            return False
+        if self.heartbeat_interval_s <= 0:
+            self.heartbeat_interval_s = float(
+                reply.get("heartbeat_interval_s") or 2.0
+            )
+        self._apply_lease(reply)
+        self._count("fleet_registrations")
+        self.registered.set()
+        return True
+
+    def _heartbeat_once(self) -> None:
+        try:
+            msg_type, reply = self._call(P.MSG_FLEET_HEARTBEAT, {
+                "server_id": self.server_id,
+                "generation": self.generation,
+            })
+        except (ConnectionError, OSError, P.ProtocolError):
+            self._count("fleet_heartbeat_errors")
+            return
+        if msg_type == P.MSG_FLEET_HEARTBEAT_OK:
+            self._count("fleet_heartbeats")
+            self._apply_lease(reply)
+        elif (
+            msg_type == P.MSG_ERROR
+            and UNKNOWN_MEMBER_MARKER in str(reply.get("message", ""))
+        ):
+            # Expired while partitioned, or the coordinator restarted and
+            # lost the table — rejoin rather than beat into the void.
+            self.registered.clear()
+            self._register()
+        else:
+            self._count("fleet_heartbeat_errors")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _run(self) -> None:
+        backoff = self.backoff_s
+        while not self._stop.is_set():
+            if not self.registered.is_set():
+                if self._register():
+                    backoff = self.backoff_s
+                else:
+                    # Coordinator missing/unreachable: keep serving, keep
+                    # retrying — discovery degrades, the data plane doesn't.
+                    if self._stop.wait(backoff):
+                        return
+                    backoff = min(backoff * 2, 5.0)
+                    continue
+            interval = self.heartbeat_interval_s or 2.0
+            if self._stop.wait(interval):
+                return
+            if self._paused.is_set():  # chaos partition: alive but silent
+                continue
+            self._heartbeat_once()
+
+    def start(self) -> "FleetAgent":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="ldt-fleet-agent"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, deregister: bool = True) -> None:
+        """Graceful leave: halt the loop, then best-effort DEREGISTER so the
+        lease reassigns now instead of at TTL expiry."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if deregister and self.registered.is_set():
+            try:
+                self._call(P.MSG_FLEET_DEREGISTER,
+                           {"server_id": self.server_id})
+            except (ConnectionError, OSError, P.ProtocolError):
+                pass  # coordinator gone: expiry will reap the lease
+        self.registered.clear()
+
+    def abort(self) -> None:
+        """Crash-shaped leave (chaos ``kill``): no deregister — the
+        coordinator finds out the hard way, at heartbeat expiry."""
+        self.stop(deregister=False)
+
+    def pause_heartbeats(self) -> None:
+        """Chaos ``partition``: the server keeps serving but goes silent on
+        the control plane; the coordinator expires its lease at TTL."""
+        self._paused.set()
+
+    def resume_heartbeats(self) -> None:
+        self._paused.clear()

@@ -1,0 +1,608 @@
+"""``FleetLoader`` — one trainer shard striped across N data servers.
+
+Drop-in replacement for :class:`~..service.client.RemoteLoader` that takes a
+*coordinator* address instead of a server address: it resolves the live
+membership, opens one protocol-v3 stream per member with
+``stripe_index/stripe_count`` HELLOs (member ``i`` of ``n`` serves exactly
+the plan steps ``s % n == i``), and merges the streams back into plan order
+— so the yielded batch sequence is **bit-identical** to a single
+``RemoteLoader`` against one server, while decode bandwidth scales with the
+fleet.
+
+Failover model (the reason this class exists): the merge loop owns a single
+global cursor — the first step not yet handed to the consumer. When any
+stripe's connection dies (server crash, network cut), the whole round is
+torn down (buffered-but-unyielded batches released back to the pool),
+membership is re-resolved with the dead address excluded, and a fresh set
+of stripes is opened with ``start_step = cursor`` over the survivors. Every
+step below the cursor was already delivered exactly once; every step at or
+above it is served exactly once by the new striping — no loss, no
+duplication, the ``RemoteLoader`` contract preserved across server loss.
+
+A *stall* is not a failure: mid-stream receives carry no deadline (same
+policy as ``RemoteLoader`` — a slow decode must not be misread as a dead
+peer), so a stalled server just holds its stripe's consumer until TCP or a
+real disconnect says otherwise.
+
+Coordinator loss degrades discovery, not the stream in flight: resolution
+is only needed at iteration start and at failover, and both retry with
+backoff.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Callable, Iterator, Optional, Sequence
+
+from ..obs.lineage import observe_wire_lineage
+from ..obs.registry import MetricsRegistry, default_registry
+from ..utils.metrics import ServiceCounters
+from ..service import protocol as P
+
+__all__ = ["FleetLoader"]
+
+_SENTINEL = object()
+_STRIPE_END = object()
+
+
+class _StripeFailure(Exception):
+    """A member's data stream failed (connect or mid-stream) — the signal
+    that triggers a failover round, never surfaced to the consumer."""
+
+    def __init__(self, addr: str, cause: Exception):
+        super().__init__(f"{addr}: {cause}")
+        self.addr = addr
+        self.cause = cause
+
+
+class _StripeRound:
+    """One striping of the plan's remaining steps over a member list.
+
+    Owns one socket + pump thread + bounded queue per member; the merge
+    loop (:meth:`next_batch`) pops step ``s`` from queue ``s % n``. Lives
+    until the plan completes, a stripe fails, or the loader closes.
+    """
+
+    def __init__(self, loader: "FleetLoader", members: list, cursor: int,
+                 stop: threading.Event):
+        self.loader = loader
+        self.members = members
+        self.cursor = cursor
+        self.stop = stop
+        self.count = len(members)
+        self.queues = [
+            queue.Queue(maxsize=max(1, loader.stripe_queue_depth))
+            for _ in members
+        ]
+        self.threads: list = []
+        self.socks: list = []
+        self.failed = threading.Event()
+        self.failed_addr: Optional[str] = None
+        self.closed = False
+
+    def connect(self) -> None:
+        """Dial every member's stripe. Raises :class:`_StripeFailure` (all
+        opened sockets closed) when any member is unreachable — the caller
+        excludes that address and re-stripes."""
+        for i, member in enumerate(self.members):
+            try:
+                sock = self.loader._dial_member(
+                    member["addr"], self.cursor, i, self.count, self.stop
+                )
+            except (ConnectionError, OSError) as exc:
+                self.close()
+                raise _StripeFailure(member["addr"], exc)
+            self.socks.append(sock)
+        for i, (member, sock) in enumerate(zip(self.members, self.socks)):
+            t = threading.Thread(
+                target=self._pump, args=(i, member["addr"], sock),
+                daemon=True, name=f"ldt-fleet-stripe-{i}",
+            )
+            t.start()
+            self.threads.append(t)
+
+    def _fail(self, addr: str) -> None:
+        if not self.failed.is_set():
+            self.failed_addr = addr
+            self.failed.set()
+
+    def _pump(self, i: int, addr: str, sock: socket.socket) -> None:
+        """Receiver thread for stripe ``i``: frames → bounded queue, ACK
+        each step. A connection error marks the round failed (failover); a
+        protocol/server error is fatal and rides the queue to the merge
+        loop."""
+        loader = self.loader
+        # First step of this stripe at or above the round's cursor.
+        expected = self.cursor + (i - self.cursor) % self.count
+        reader = P.FrameReader(sock)
+        try:
+            while not self.stop.is_set():
+                try:
+                    msg_type, payload = reader.recv_msg()
+                except (ConnectionError, OSError) as exc:
+                    if not (self.closed or self.stop.is_set()):
+                        self._fail(addr)
+                    return
+                if msg_type == P.MSG_BATCH:
+                    recv_ns = time.time_ns()
+                    step, batch, lineage = P.decode_batch(
+                        payload["raw"], with_lineage=True,
+                        pool=loader.buffer_pool,
+                    )
+                    if step != expected:
+                        raise P.ProtocolError(
+                            f"stripe {i}/{self.count}: out-of-order step "
+                            f"{step}, expected {expected}"
+                        )
+                    observed = observe_wire_lineage(
+                        loader.registry, lineage, recv_ns
+                    )
+                    if observed is not None:
+                        loader.last_lineage = observed
+                        loader.recent_lineage.append(observed)
+                    expected += self.count
+                    try:
+                        P.send_msg(sock, P.MSG_ACK, {"step": step})
+                    except (ConnectionError, OSError):
+                        pass  # the next recv sees the drop
+                    loader.counters.add("batches_received")
+                    t0 = time.perf_counter()
+                    self._put(i, (step, batch))
+                    loader.counters.add(
+                        "recv_backpressure_s", time.perf_counter() - t0
+                    )
+                elif msg_type == P.MSG_END:
+                    self._put(i, _STRIPE_END)
+                    return
+                elif msg_type == P.MSG_ERROR:
+                    raise RuntimeError(
+                        f"data server {addr}: {payload.get('message')}"
+                    )
+                else:
+                    raise P.ProtocolError(f"unexpected message {msg_type}")
+        except BaseException as exc:  # fatal: surface through the merge loop
+            self._put(i, exc)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _put(self, i: int, item) -> None:
+        """Bounded put that a close() can always unblock (the queue is
+        drained on teardown, so a blocked pump exits within one timeout)."""
+        while not (self.closed or self.stop.is_set()):
+            try:
+                self.queues[i].put(item, timeout=0.25)
+                return
+            except queue.Full:
+                continue
+
+    def next_batch(self, step: int):
+        """Blocking pop of ``step`` from its owner stripe. Returns the host
+        batch, raises :class:`_StripeFailure` on a member loss, re-raises
+        fatal pump errors, and returns ``None`` when the loader closed."""
+        q = self.queues[step % self.count]
+        while not self.stop.is_set():
+            try:
+                item = q.get(timeout=0.25)
+            except queue.Empty:
+                if self.failed.is_set():
+                    raise _StripeFailure(
+                        self.failed_addr or "?",
+                        ConnectionError("stripe connection lost"),
+                    )
+                continue
+            if item is _STRIPE_END:
+                # The owner of an unserved step ended early: the server's
+                # plan disagrees with ours — fatal, not a failover.
+                raise P.ProtocolError(
+                    f"stripe ended before step {step} was served"
+                )
+            if isinstance(item, _StripeFailure):
+                raise item
+            if isinstance(item, BaseException):
+                raise item
+            got, batch = item
+            if got != step:
+                raise P.ProtocolError(
+                    f"merge expected step {step}, stripe delivered {got}"
+                )
+            return batch
+        return None
+
+    def close(self) -> None:
+        """Tear the round down and RELEASE every buffered-but-unyielded
+        batch's pool leases (a failover drops up to
+        ``n * stripe_queue_depth`` decoded batches — they must go back to
+        the pool, not strand)."""
+        self.closed = True
+        for sock in self.socks:
+            try:
+                # shutdown BEFORE close: a pump blocked in recv holds the
+                # last kernel reference, so a bare close() would neither
+                # wake it nor send FIN — the same fd-close-vs-blocked-recv
+                # trap _ClientSession.close() documents server-side.
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for q, t in zip(self.queues, self.threads):
+            while t.is_alive():
+                try:
+                    self._release_item(q.get_nowait())
+                except queue.Empty:
+                    t.join(timeout=0.1)
+        for q in self.queues:  # pumps gone: drain the leftovers
+            while True:
+                try:
+                    self._release_item(q.get_nowait())
+                except queue.Empty:
+                    break
+
+    def _release_item(self, item) -> None:
+        if isinstance(item, tuple) and len(item) == 2:
+            self.loader._release(item[1])
+
+
+class FleetLoader:
+    """Iterate device-ready batches served by a fleet of data servers.
+
+    Parameters mirror :class:`~..service.client.RemoteLoader` where they
+    overlap; ``coordinator_addr`` replaces the single server address.
+    """
+
+    def __init__(
+        self,
+        coordinator_addr: str,
+        batch_size: int,
+        process_index: int,
+        process_count: int,
+        device_put_fn: Optional[Callable[[dict], dict]] = None,
+        *,
+        sampler_type: str = "batch",
+        shuffle: bool = False,
+        seed: int = 0,
+        epoch: int = 0,
+        prefetch: int = 2,
+        columns: Optional[Sequence[str]] = None,
+        connect_retries: int = 3,
+        resolve_retries: int = 10,
+        backoff_s: float = 0.2,
+        timeout_s: float = 120.0,
+        task_type: Optional[str] = None,
+        image_size: Optional[int] = None,
+        registry: Optional[MetricsRegistry] = None,
+        buffer_pool=None,
+        stripe_queue_depth: int = 2,
+        exclusion_ttl_s: float = 10.0,
+    ):
+        self.coordinator_host, self.coordinator_port = P.parse_hostport(
+            coordinator_addr
+        )
+        self.batch_size = batch_size
+        self.process_index = process_index
+        self.process_count = process_count
+        self.device_put_fn = device_put_fn
+        self.sampler_type = sampler_type
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = epoch
+        self.prefetch = max(1, prefetch)
+        self.columns = list(columns) if columns is not None else None
+        self.connect_retries = max(1, connect_retries)
+        self.resolve_retries = max(1, resolve_retries)
+        self.backoff_s = backoff_s
+        self.timeout_s = timeout_s
+        self.task_type = task_type
+        self.image_size = image_size
+        self.registry = registry if registry is not None else default_registry()
+        self.counters = ServiceCounters(prefix="fleet", registry=self.registry)
+        self.buffer_pool = buffer_pool
+        self.stripe_queue_depth = stripe_queue_depth
+        self.exclusion_ttl_s = exclusion_ttl_s
+        self.recent_lineage: deque = deque(maxlen=1024)
+        self.last_lineage: Optional[dict] = None
+        self.client_id = uuid.uuid4().hex
+        self.generation: int = 0  # last resolved lease generation
+        self._num_steps: Optional[int] = None
+        # addr -> monotonic deadline: members excluded from striping after a
+        # failure, until the TTL lapses (a recovered server rejoins rounds).
+        self._excluded: dict = {}
+
+    # -- coordinator --------------------------------------------------------
+
+    def _resolve_once(self) -> dict:
+        with socket.create_connection(
+            (self.coordinator_host, self.coordinator_port),
+            timeout=min(self.timeout_s, 10.0),
+        ) as sock:
+            P.send_msg(sock, P.MSG_FLEET_RESOLVE, {})
+            msg_type, reply = P.recv_msg(
+                sock, deadline=time.monotonic() + min(self.timeout_s, 10.0)
+            )
+        if msg_type != P.MSG_FLEET_RESOLVE_OK:
+            raise P.ProtocolError(
+                f"coordinator answered message type {msg_type}: "
+                f"{reply.get('message', '')}"
+            )
+        return reply
+
+    def _resolve_members(
+        self, stop: Optional[threading.Event] = None,
+    ) -> list:
+        """Membership with retry/backoff (an empty fleet keeps retrying —
+        members may still be booting). Returns the member list sorted by
+        ``server_id`` (the deterministic stripe order), with recently-failed
+        addresses excluded — unless exclusion would empty the list, in which
+        case the exclusions are dropped (a possibly-recovered server beats
+        certain starvation)."""
+        last: Optional[Exception] = None
+        backoff = self.backoff_s
+        for _ in range(self.resolve_retries):
+            if stop is not None and stop.is_set():
+                raise ConnectionError("loader closed during resolve")
+            try:
+                reply = self._resolve_once()
+            except (ConnectionError, OSError, P.ProtocolError) as exc:
+                last = exc
+                self.counters.add("resolve_errors")
+            else:
+                self.counters.add("resolves")
+                self.generation = int(reply.get("generation", 0))
+                self.counters.gauge("lease_generation", self.generation)
+                members = sorted(
+                    reply.get("members", []),
+                    key=lambda m: str(m.get("server_id", "")),
+                )
+                self.counters.gauge("members", len(members))
+                now = time.monotonic()
+                self._excluded = {
+                    a: t for a, t in self._excluded.items() if t > now
+                }
+                live = [
+                    m for m in members
+                    if m.get("addr") not in self._excluded
+                ]
+                if not live:
+                    live = members  # all excluded: try everyone again
+                if live:
+                    return live
+                last = ConnectionError("fleet has no registered members")
+            if stop is not None:
+                if stop.wait(backoff):
+                    raise ConnectionError("loader closed during resolve")
+            else:
+                time.sleep(backoff)
+            backoff = min(backoff * 2, 2.0)
+        raise ConnectionError(
+            f"fleet coordinator {self.coordinator_host}:"
+            f"{self.coordinator_port}: no usable membership after "
+            f"{self.resolve_retries} attempts: {last}"
+        ) from last
+
+    # -- data servers -------------------------------------------------------
+
+    def _hello(self, start_step: int, stripe_index: int, stripe_count: int,
+               probe: bool = False) -> dict:
+        return P.hello(
+            batch_size=self.batch_size,
+            process_index=self.process_index,
+            process_count=self.process_count,
+            sampler_type=self.sampler_type,
+            shuffle=self.shuffle,
+            seed=self.seed,
+            epoch=self.epoch,
+            start_step=start_step,
+            stripe_index=stripe_index,
+            stripe_count=stripe_count,
+            columns=self.columns,
+            client_id=self.client_id,
+            probe=probe,
+            task_type=self.task_type,
+            image_size=self.image_size,
+        )
+
+    def _dial_member(self, addr: str, start_step: int, stripe_index: int,
+                     stripe_count: int, stop: Optional[threading.Event],
+                     probe: bool = False):
+        """Dial + v3 handshake with one member. ConnectionError after the
+        quick per-member retries means *this member* is down (failover
+        material); a handshake rejection is fatal — a fleet whose servers
+        reject our plan parameters cannot be failed over to."""
+        host, port = P.parse_hostport(addr)
+        last: Optional[Exception] = None
+        backoff = self.backoff_s
+        for attempt in range(self.connect_retries):
+            if stop is not None and stop.is_set():
+                raise ConnectionError("loader closed during connect")
+            sock = None
+            try:
+                sock = socket.create_connection(
+                    (host, port), timeout=min(self.timeout_s, 10.0)
+                )
+                sock.settimeout(self.timeout_s)  # handshake recv bound
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                P.send_msg(sock, P.MSG_HELLO, self._hello(
+                    start_step, stripe_index, stripe_count, probe
+                ))
+                msg_type, reply = P.recv_msg(sock)
+                if msg_type == P.MSG_ERROR:
+                    raise P.ProtocolError(
+                        f"data server {addr} rejected handshake: "
+                        f"{reply.get('message', '')}"
+                    )
+                if msg_type != P.MSG_HELLO_OK:
+                    raise P.ProtocolError(
+                        f"expected HELLO_OK, got message type {msg_type}"
+                    )
+                # Striping is NOT downgrade-safe: a pre-v3 server would
+                # ignore the stripe fields and serve EVERY step — silent
+                # duplication across the fleet. Unlike RemoteLoader there
+                # is no version-downgrade retry here, by design.
+                if int(reply.get("version", 0)) < P.STRIPE_MIN_VERSION:
+                    raise P.ProtocolError(
+                        f"data server {addr} speaks protocol "
+                        f"{reply.get('version')} < {P.STRIPE_MIN_VERSION} "
+                        "(no stripe support) — upgrade it before fleeting"
+                    )
+                self._num_steps = int(reply["num_steps"])
+                sock.settimeout(None)  # streaming phase: no recv deadline
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+                return sock
+            except P.ProtocolError:
+                if sock is not None:
+                    sock.close()
+                raise
+            except (ConnectionError, OSError) as exc:
+                if sock is not None:
+                    sock.close()
+                last = exc
+                self.counters.add("connect_retries")
+                if attempt + 1 < self.connect_retries:
+                    if stop is not None:
+                        if stop.wait(backoff):
+                            raise ConnectionError(
+                                "loader closed during connect"
+                            ) from exc
+                    else:
+                        time.sleep(backoff)
+                    backoff = min(backoff * 2, 2.0)
+        raise ConnectionError(
+            f"data server {addr} unreachable after "
+            f"{self.connect_retries} attempts: {last}"
+        ) from last
+
+    # -- plan metadata ------------------------------------------------------
+
+    def __len__(self) -> int:
+        """Step count of this shard's plan (probe handshake against any
+        live member, cached)."""
+        if self._num_steps is None:
+            members = self._resolve_members()
+            last: Optional[Exception] = None
+            for m in members:
+                try:
+                    sock = self._dial_member(
+                        m["addr"], 0, 0, 1, None, probe=True
+                    )
+                    sock.close()
+                    break
+                except (ConnectionError, OSError) as exc:
+                    last = exc
+            else:
+                raise ConnectionError(
+                    f"no fleet member reachable for probe: {last}"
+                ) from last
+        return int(self._num_steps)
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reshuffle parity with ``RemoteLoader.set_epoch``."""
+        if epoch != self.epoch:
+            self.epoch = epoch
+            self._num_steps = None
+
+    def _release(self, batch) -> None:
+        if self.buffer_pool is not None:
+            self.buffer_pool.release_batch(batch)
+
+    # -- iteration ----------------------------------------------------------
+
+    def _receive(self, q: "queue.Queue", stop: threading.Event) -> None:
+        """Orchestrator thread: stripe rounds → merged plan-order stream
+        into the bounded queue, restriping from the cursor on member loss."""
+        cursor = 0  # first step not yet handed to the consumer
+        try:
+            if self._num_steps is None:
+                self.__len__()  # probe via any member (retries inside)
+            num_steps = int(self._num_steps)
+            while cursor < num_steps and not stop.is_set():
+                members = self._resolve_members(stop)
+                t0 = time.perf_counter()
+                rnd = _StripeRound(self, members, cursor, stop)
+                try:
+                    rnd.connect()
+                except _StripeFailure as f:
+                    self._failover(f, cursor)
+                    continue
+                self.counters.gauge("stripes", rnd.count)
+                if cursor > 0:
+                    # Failover restripe cost, dial-to-streaming. The initial
+                    # stripe setup is not a REbalance and stays out.
+                    self.counters.observe(
+                        "rebalance_ms", (time.perf_counter() - t0) * 1e3
+                    )
+                try:
+                    while cursor < num_steps and not stop.is_set():
+                        batch = rnd.next_batch(cursor)
+                        if batch is None:  # loader closed
+                            return
+                        q.put(batch)
+                        cursor += 1
+                except _StripeFailure as f:
+                    self._failover(f, cursor)
+                    continue  # the finally below tears the round down
+                finally:
+                    rnd.close()
+            if cursor >= num_steps:
+                q.put(_SENTINEL)
+        except BaseException as exc:  # surface to the consumer
+            q.put(exc)
+
+    def _failover(self, failure: _StripeFailure, cursor: int) -> None:
+        """A member was lost: exclude its address for a TTL (the next
+        resolve stripes over the survivors) and count the event."""
+        self._excluded[failure.addr] = (
+            time.monotonic() + self.exclusion_ttl_s
+        )
+        self.counters.add("failovers_total")
+        self.counters.gauge("resume_cursor", cursor)
+
+    def __iter__(self) -> Iterator[dict]:
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        receiver = threading.Thread(
+            target=self._receive, args=(q, stop), daemon=True,
+            name="ldt-fleet-loader",
+        )
+        receiver.start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = q.get()
+                # Consumer blocked on an empty queue: the fleet (wire or
+                # decode) is the bottleneck — attributable via
+                # StepTimer.attach_counters, same as RemoteLoader.
+                self.counters.add("client_stall_s", time.perf_counter() - t0)
+                if item is _SENTINEL:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                host = item
+                if self.device_put_fn is not None:
+                    item = self.device_put_fn(host)
+                    self._release(host)
+                    host = None
+                yield item
+                if host is not None:
+                    self._release(host)
+        finally:
+            stop.set()
+            while receiver.is_alive():
+                try:
+                    # Drained items are undelivered host batches — return
+                    # their pool leases on the way out.
+                    drained = q.get_nowait()
+                    if not (drained is _SENTINEL
+                            or isinstance(drained, BaseException)):
+                        self._release(drained)
+                except queue.Empty:
+                    receiver.join(timeout=0.1)

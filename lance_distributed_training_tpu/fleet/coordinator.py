@@ -1,0 +1,409 @@
+"""``Coordinator`` — the fleet's control plane.
+
+Tracks data-server membership and owns the **shard leases**: every live
+member holds a generation-numbered lease naming its stripe of the fleet and
+its slice of the global fragment space. The lease table is recomputed —
+generation bumped — on every membership change (register, deregister,
+heartbeat expiry), and members learn their new lease in the next heartbeat
+reply; clients learn the new layout from ``RESOLVE``. The coordinator never
+touches batch data: the data plane stays strictly client↔server
+(``FleetLoader`` stripes v3 HELLOs across the members it resolves here), so
+a coordinator crash degrades discovery, not the streams in flight.
+
+Division of authority (read this before "improving" either half): the
+**stripe_index/stripe_count** in a lease and in RESOLVE is what clients
+stripe by — it is the correctness-bearing part, enforced end-to-end by the
+client's plan-order merge. The **fragment_lo/fragment_hi** slice is
+*advisory*: servers stay stateless decode planes that can serve any step of
+any plan (that statelessness is exactly what makes failover a pure client
+re-stripe), so the fragment slice does not gate what a server will serve.
+It exists for operators (capacity math on /healthz: which member owns how
+much of the dataset at the current generation) and for locality-aware
+read-ahead, and a lease *change* is the signal members key cache
+invalidation on (``DataService._on_lease_change`` drops its plan cache).
+
+Protocol: the fleet message types of :mod:`..service.protocol` — one
+request, one reply, per short-lived connection. No streaming state means a
+wedged peer costs one handler thread for one ``handshake_timeout_s``
+deadline, nothing more.
+
+Thread & queue policy (``ldt check`` LDT201/LDT203): every thread is
+``daemon=True``; every control recv carries a deadline. The coordinator has
+no queues — its whole state is the lease table under one lock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import threading
+import time
+from typing import Optional
+
+from ..obs.registry import MetricsRegistry, default_registry
+from ..service import protocol as P
+
+__all__ = ["CoordinatorConfig", "Coordinator", "serve_coordinator",
+           "UNKNOWN_MEMBER_MARKER"]
+
+# Error-message prefix a heartbeat from an expired/unknown member gets back.
+# The agent keys its re-register path on this marker (wire prose — frozen,
+# same contract as VERSION_MISMATCH_MARKER).
+UNKNOWN_MEMBER_MARKER = "unknown fleet member"
+
+
+@dataclasses.dataclass
+class CoordinatorConfig:
+    """Control-plane knobs. The data servers and trainers bring their own
+    config — the coordinator only owns membership and leases."""
+
+    host: str = "0.0.0.0"
+    port: int = 8470  # 0 = ephemeral (the bound port is Coordinator.port)
+    heartbeat_interval_s: float = 2.0  # advertised to members at register
+    lease_ttl_s: float = 6.0  # heartbeat silence after which a member is
+    # expired and its lease reassigned (>= 2-3 heartbeat intervals, so one
+    # dropped packet never churns the lease table)
+    handshake_timeout_s: float = 10.0  # per-connection request deadline
+    log_every_s: float = 0.0  # >0: periodic membership line to stdout
+    metrics_port: Optional[int] = None  # /metrics + /healthz (same contract
+    # as ServeConfig.metrics_port: None = off, 0 = ephemeral)
+    metrics_host: str = "127.0.0.1"  # loopback default; /healthz lists
+    # member addresses unauthenticated, so non-loopback is an opt-in
+
+
+class _Member:
+    """One registered data server and its current lease."""
+
+    __slots__ = ("server_id", "addr", "num_fragments", "last_heartbeat",
+                 "stripe_index", "fragment_lo", "fragment_hi")
+
+    def __init__(self, server_id: str, addr: str, num_fragments: int):
+        self.server_id = server_id
+        self.addr = addr
+        self.num_fragments = num_fragments
+        self.last_heartbeat = time.monotonic()
+        self.stripe_index = 0
+        self.fragment_lo = 0
+        self.fragment_hi = 0
+
+    def lease(self, generation: int, stripe_count: int) -> dict:
+        return {
+            "generation": generation,
+            "stripe_index": self.stripe_index,
+            "stripe_count": stripe_count,
+            "fragment_lo": self.fragment_lo,
+            "fragment_hi": self.fragment_hi,
+        }
+
+
+class Coordinator:
+    """Serve fleet membership + shard leases over TCP until :meth:`stop`."""
+
+    def __init__(self, config: CoordinatorConfig,
+                 registry: Optional[MetricsRegistry] = None):
+        self.config = config
+        self.registry = registry if registry is not None else default_registry()
+        self._members: dict[str, _Member] = {}
+        self._lock = threading.Lock()
+        self.generation = 0
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._expiry_thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self.port: Optional[int] = None
+        self._metrics = None
+        self.metrics_port: Optional[int] = None
+
+    # -- lease table --------------------------------------------------------
+
+    def _rebalance_locked(self) -> None:
+        """Recompute every member's lease (caller holds ``_lock``): stripes
+        by sorted server_id (deterministic across coordinator restarts), the
+        fragment space split into contiguous near-equal slices. Bumps the
+        generation — the one number every cache keys on."""
+        t0 = time.perf_counter()
+        self.generation += 1
+        members = sorted(self._members.values(), key=lambda m: m.server_id)
+        count = len(members)
+        total_fragments = max(
+            (m.num_fragments for m in members), default=0
+        )
+        for i, m in enumerate(members):
+            m.stripe_index = i
+            if count and total_fragments:
+                lo = (total_fragments * i) // count
+                hi = (total_fragments * (i + 1)) // count
+            else:
+                lo = hi = 0
+            m.fragment_lo, m.fragment_hi = lo, hi
+        self.registry.gauge("fleet_members").set(count)
+        self.registry.gauge("fleet_lease_generation").set(self.generation)
+        self.registry.histogram("fleet_rebalance_ms").observe(
+            (time.perf_counter() - t0) * 1e3
+        )
+
+    def _members_payload_locked(self) -> dict:
+        now = time.monotonic()
+        members = sorted(self._members.values(), key=lambda m: m.server_id)
+        return {
+            "generation": self.generation,
+            "stripe_count": len(members),
+            "members": [
+                {
+                    "server_id": m.server_id,
+                    "addr": m.addr,
+                    "stripe_index": m.stripe_index,
+                    "fragment_lo": m.fragment_lo,
+                    "fragment_hi": m.fragment_hi,
+                    "heartbeat_age_s": round(now - m.last_heartbeat, 3),
+                }
+                for m in members
+            ],
+        }
+
+    # -- request handlers ---------------------------------------------------
+
+    def _handle_register(self, req: dict) -> tuple:
+        server_id = str(req.get("server_id") or "")
+        addr = str(req.get("addr") or "")
+        if not server_id or not addr:
+            return P.MSG_ERROR, {"message": "register needs server_id + addr"}
+        P.parse_hostport(addr)  # reject an undialable advertise addr loudly
+        num_fragments = int(req.get("num_fragments") or 0)
+        with self._lock:
+            known = self._members.get(server_id)
+            if known is not None and known.addr == addr:
+                # Idempotent re-register (agent retry, partition heal with
+                # nothing else changed): refresh liveness, same lease table.
+                known.last_heartbeat = time.monotonic()
+                known.num_fragments = num_fragments or known.num_fragments
+            else:
+                self._members[server_id] = _Member(
+                    server_id, addr, num_fragments
+                )
+                self._rebalance_locked()
+            member = self._members[server_id]
+            reply = {
+                "generation": self.generation,
+                "heartbeat_interval_s": self.config.heartbeat_interval_s,
+                "lease_ttl_s": self.config.lease_ttl_s,
+                "lease": member.lease(self.generation, len(self._members)),
+            }
+        self.registry.counter("fleet_registrations_total").inc()
+        self._log(f"member {server_id} registered at {addr} "
+                  f"(generation {reply['generation']})")
+        return P.MSG_FLEET_REGISTER_OK, reply
+
+    def _handle_heartbeat(self, req: dict) -> tuple:
+        server_id = str(req.get("server_id") or "")
+        with self._lock:
+            member = self._members.get(server_id)
+            if member is None:
+                # Expired (or a coordinator restart lost the table): the
+                # agent re-registers on this marker instead of beating into
+                # the void forever.
+                return P.MSG_ERROR, {
+                    "message": f"{UNKNOWN_MEMBER_MARKER}: {server_id!r} — "
+                               "re-register"
+                }
+            member.last_heartbeat = time.monotonic()
+            reply = {
+                "generation": self.generation,
+                "lease": member.lease(self.generation, len(self._members)),
+            }
+        self.registry.counter("fleet_heartbeats_total").inc()
+        return P.MSG_FLEET_HEARTBEAT_OK, reply
+
+    def _handle_deregister(self, req: dict) -> tuple:
+        server_id = str(req.get("server_id") or "")
+        with self._lock:
+            if self._members.pop(server_id, None) is not None:
+                self._rebalance_locked()
+            generation = self.generation
+        self.registry.counter("fleet_deregistrations_total").inc()
+        self._log(f"member {server_id} deregistered "
+                  f"(generation {generation})")
+        return P.MSG_FLEET_DEREGISTER_OK, {"generation": generation}
+
+    def _handle_resolve(self, req: dict) -> tuple:
+        with self._lock:
+            payload = self._members_payload_locked()
+        self.registry.counter("fleet_resolves_total").inc()
+        return P.MSG_FLEET_RESOLVE_OK, payload
+
+    # -- expiry -------------------------------------------------------------
+
+    def _expire_loop(self) -> None:
+        ttl = self.config.lease_ttl_s
+        poll = max(min(ttl / 4.0, 1.0), 0.05)
+        while not self._stopped.wait(poll):
+            now = time.monotonic()
+            expired = []
+            with self._lock:
+                for server_id, m in list(self._members.items()):
+                    if now - m.last_heartbeat > ttl:
+                        expired.append(server_id)
+                        del self._members[server_id]
+                if expired:
+                    self._rebalance_locked()
+                    generation = self.generation
+            if expired:
+                self.registry.counter("fleet_expirations_total").inc(
+                    len(expired)
+                )
+                self._log(
+                    f"expired {expired} after {ttl}s heartbeat silence "
+                    f"(generation {generation})"
+                )
+
+    # -- control plane ------------------------------------------------------
+
+    def start(self) -> "Coordinator":
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self.config.host, self.config.port))
+        sock.listen(64)
+        self._sock = sock
+        self.port = sock.getsockname()[1]
+        if self.config.metrics_port is not None:
+            from ..obs.http import MetricsHTTPServer
+
+            try:
+                self._metrics = MetricsHTTPServer(
+                    self.registry,
+                    port=self.config.metrics_port,
+                    host=self.config.metrics_host,
+                    healthz_fn=self._healthz,
+                ).start()
+            except OSError:
+                sock.close()
+                self._sock = None
+                raise
+            self.metrics_port = self._metrics.port
+            self._log(f"metrics on :{self.metrics_port} (/metrics, /healthz)")
+        # Gauges exist from second zero — a scrape of an empty fleet reads
+        # 0 members / generation 0, not absent series.
+        self.registry.gauge("fleet_members").set(0)
+        self.registry.gauge("fleet_lease_generation").set(self.generation)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="ldt-fleet-accept"
+        )
+        self._accept_thread.start()
+        self._expiry_thread = threading.Thread(
+            target=self._expire_loop, daemon=True, name="ldt-fleet-expiry"
+        )
+        self._expiry_thread.start()
+        self._log(f"coordinating on {self.config.host}:{self.port}")
+        return self
+
+    def _healthz(self) -> dict:
+        with self._lock:
+            payload = self._members_payload_locked()
+        stopped = self._stopped.is_set()
+        payload["status"] = "degraded" if stopped else "ok"
+        payload["lease_ttl_s"] = self.config.lease_ttl_s
+        payload["heartbeat_interval_s"] = self.config.heartbeat_interval_s
+        return payload
+
+    def _accept_loop(self) -> None:
+        assert self._sock is not None
+        while not self._stopped.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:  # listener closed by stop()
+                return
+            threading.Thread(
+                target=self._handle_conn, args=(conn, f"{addr[0]}:{addr[1]}"),
+                daemon=True, name=f"ldt-fleet-conn-{addr[1]}",
+            ).start()
+
+    def _handle_conn(self, conn: socket.socket, peer: str) -> None:
+        """One request, one reply, close — the control-plane handshake. The
+        deadline bounds the whole request read (a silent peer is dropped,
+        LDT203), and any reply-side error just abandons the connection."""
+        try:
+            timeout = self.config.handshake_timeout_s
+            deadline = time.monotonic() + timeout if timeout > 0 else None
+            msg_type, req = P.recv_msg(conn, deadline=deadline)
+            handler = {
+                P.MSG_FLEET_REGISTER: self._handle_register,
+                P.MSG_FLEET_HEARTBEAT: self._handle_heartbeat,
+                P.MSG_FLEET_DEREGISTER: self._handle_deregister,
+                P.MSG_FLEET_RESOLVE: self._handle_resolve,
+            }.get(msg_type)
+            if handler is None:
+                reply_type, reply = P.MSG_ERROR, {
+                    "message": f"unexpected fleet message type {msg_type}"
+                }
+            else:
+                try:
+                    reply_type, reply = handler(req)
+                except (ValueError, TypeError, KeyError) as exc:
+                    reply_type, reply = P.MSG_ERROR, {"message": repr(exc)}
+            P.send_msg(conn, reply_type, reply)
+        except (ConnectionError, OSError, P.ProtocolError):
+            pass  # dead/garbage peer: nothing to answer
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def serve_forever(self) -> None:
+        """Blocking serve (the ``ldt coordinator`` entry). SIGTERM (docker
+        stop, k8s preemption) and KeyboardInterrupt both drain through
+        :meth:`stop` — the lease table dies with the process, members
+        re-register against a successor."""
+        from ..utils.signals import install_sigterm_handler
+
+        if self._sock is None:
+            self.start()
+        install_sigterm_handler(self._stopped.set)
+        try:
+            interval = self.config.log_every_s
+            while not self._stopped.wait(interval if interval > 0 else 3600.0):
+                if interval > 0:
+                    with self._lock:
+                        line = self._members_payload_locked()
+                    self._log(f"generation {line['generation']}, "
+                              f"{line['stripe_count']} members")
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._metrics is not None:
+            self._metrics.stop()
+            self._metrics = None
+        if self._sock is not None:
+            try:
+                # shutdown wakes a concurrently-blocked accept(); a bare
+                # close can leave the kernel listener alive while the
+                # syscall holds the last reference (see DataService.stop).
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+        if self._expiry_thread is not None:
+            self._expiry_thread.join(timeout=2.0)
+
+    def __enter__(self) -> "Coordinator":
+        return self.start() if self._sock is None else self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _log(self, msg: str) -> None:
+        print(f"[coordinator] {msg}", flush=True)
+
+
+def serve_coordinator(config: CoordinatorConfig) -> None:
+    """Module-level convenience for the CLI."""
+    Coordinator(config).serve_forever()

@@ -67,12 +67,9 @@ class RemoteLoader:
         registry: Optional[MetricsRegistry] = None,
         buffer_pool=None,
     ):
-        host, sep, port = addr.rpartition(":")
-        if not sep or not port.isdigit():
-            raise ValueError(
-                f"data service address must be host:port, got {addr!r}"
-            )
-        self.host, self.port = host or "127.0.0.1", int(port)
+        # Shared parser: accepts bracketed IPv6 ([::1]:8476) — a bare
+        # rpartition(":") here used to misparse it into host "[::1".
+        self.host, self.port = P.parse_hostport(addr)
         self.batch_size = batch_size
         self.process_index = process_index
         self.process_count = process_count

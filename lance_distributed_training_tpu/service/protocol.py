@@ -36,6 +36,19 @@ see :mod:`..obs.lineage`). Backward compatible by construction: a v1
 decoder ignores unknown meta keys, and a v2 server simply omits the field
 for v1 clients; ``decode_batch(..., with_lineage=True)`` returns ``None``
 for its absence.
+
+Version 3 adds **step striping** to the HELLO (``stripe_index`` /
+``stripe_count``): the server serves only the plan steps ``s >= start_step``
+with ``s % stripe_count == stripe_index``, still in increasing order — the
+primitive the fleet client (:mod:`..fleet.balancer`) uses to spread one
+shard's plan across N data servers and re-stripe it on failover. Striping is
+NOT downgrade-safe (a v2 server would ignore the unknown keys and serve
+every step — silent duplication across the fleet), so a striping client must
+require the negotiated version to be >= ``STRIPE_MIN_VERSION`` instead of
+downgrade-retrying. Version 3 also carries the **fleet control plane**
+message types (register / heartbeat / deregister / resolve) spoken between
+data servers, the coordinator, and fleet clients — same framing, small JSON
+control payloads, one request/reply per connection.
 """
 
 from __future__ import annotations
@@ -52,6 +65,7 @@ __all__ = [
     "PROTOCOL_VERSION",
     "MIN_PROTOCOL_VERSION",
     "LINEAGE_MIN_VERSION",
+    "STRIPE_MIN_VERSION",
     "version_supported",
     "VERSION_MISMATCH_MARKER",
     "MSG_HELLO",
@@ -60,6 +74,15 @@ __all__ = [
     "MSG_ACK",
     "MSG_END",
     "MSG_ERROR",
+    "MSG_FLEET_REGISTER",
+    "MSG_FLEET_REGISTER_OK",
+    "MSG_FLEET_HEARTBEAT",
+    "MSG_FLEET_HEARTBEAT_OK",
+    "MSG_FLEET_DEREGISTER",
+    "MSG_FLEET_DEREGISTER_OK",
+    "MSG_FLEET_RESOLVE",
+    "MSG_FLEET_RESOLVE_OK",
+    "parse_hostport",
     "send_frame",
     "recv_frame",
     "send_msg",
@@ -74,12 +97,17 @@ __all__ = [
     "ProtocolError",
 ]
 
-PROTOCOL_VERSION = 2
+PROTOCOL_VERSION = 3
 # Oldest peer version this build still speaks. v1 framing is a strict
-# subset of v2 (no lineage meta key), so the floor stays at 1.
+# subset of v2 (no lineage meta key), and an unstriped v3 HELLO is a strict
+# subset of v2's, so the floor stays at 1.
 MIN_PROTOCOL_VERSION = 1
 # First version whose batch meta may carry the lineage field.
 LINEAGE_MIN_VERSION = 2
+# First version whose HELLO stripe_index/stripe_count are honoured. A
+# striping client MUST refuse older peers (they'd ignore the unknown keys
+# and serve every step — silent duplication), never downgrade-retry.
+STRIPE_MIN_VERSION = 3
 # Error-message prefix every version rejection starts with — the marker the
 # client's downgrade retry keys on. FROZEN wire prose: deployed v1 servers
 # already say exactly "protocol version mismatch: server 1, client N", and
@@ -106,6 +134,20 @@ MSG_ACK = 4  # client -> server: cursor advance {"step": n}
 MSG_END = 5  # server -> client: plan exhausted, stream complete
 MSG_ERROR = 6  # either direction: {"message": str}; connection closes after
 
+# Fleet control plane (v3+): data servers and fleet clients talk to the
+# coordinator with one request/reply per short-lived connection — the
+# coordinator never holds streaming state, so a wedged peer costs one
+# handler thread for one deadline, not a session.
+MSG_FLEET_REGISTER = 16  # server -> coord: {server_id, addr, num_fragments}
+MSG_FLEET_REGISTER_OK = 17  # coord -> server: {generation, lease, ...}
+MSG_FLEET_HEARTBEAT = 18  # server -> coord: {server_id, generation}
+MSG_FLEET_HEARTBEAT_OK = 19  # coord -> server: {generation, lease} — the
+# reply is how a member learns its lease moved (join/leave elsewhere)
+MSG_FLEET_DEREGISTER = 20  # server -> coord: {server_id} (graceful leave)
+MSG_FLEET_DEREGISTER_OK = 21  # coord -> server: {generation}
+MSG_FLEET_RESOLVE = 22  # client -> coord: {} (membership query)
+MSG_FLEET_RESOLVE_OK = 23  # coord -> client: {generation, members: [...]}
+
 _HEADER = struct.Struct(">IB")  # frame length (excluding header) | msg type
 _META_LEN = struct.Struct(">I")
 
@@ -117,6 +159,34 @@ MAX_FRAME = 2**31
 
 class ProtocolError(RuntimeError):
     """Framing/handshake violation — the connection is unusable."""
+
+
+def parse_hostport(addr: str, default_host: str = "127.0.0.1") -> Tuple[str, int]:
+    """``host:port`` / ``[v6]:port`` → ``(host, port)``.
+
+    The one address parser every dialing surface shares (RemoteLoader,
+    FleetLoader, the server's coordinator registration, the CLI). Bracketed
+    IPv6 is the RFC 3986 form — ``[::1]:8476`` must parse as host ``::1``,
+    not be misparsed by a bare ``rpartition(":")`` into host ``[::1`` — and
+    an UNbracketed multi-colon literal (``::1``) is rejected as ambiguous
+    rather than silently splitting at the last colon. ``:8476`` (empty
+    host) means ``default_host``.
+    """
+    text = addr.strip()
+    host, sep, port = text.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(
+            f"address must be host:port or [ipv6]:port, got {addr!r}"
+        )
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+        if not host:
+            raise ValueError(f"empty IPv6 host in {addr!r}")
+    elif ":" in host:
+        raise ValueError(
+            f"ambiguous IPv6 address {addr!r}: bracket the host ([::1]:port)"
+        )
+    return host or default_host, int(port)
 
 
 def _recv_exact(
@@ -435,6 +505,8 @@ def hello(
     seed: int = 0,
     epoch: int = 0,
     start_step: int = 0,
+    stripe_index: int = 0,
+    stripe_count: int = 1,
     columns: Optional[list] = None,
     client_id: str = "",
     probe: bool = False,
@@ -453,7 +525,11 @@ def hello(
 
     ``start_step`` is the resume cursor: a reconnecting client passes
     ``last_acked + 1`` and the server serves the identical plan from there
-    (no duplicated, no skipped step). ``probe=True`` asks for HELLO_OK only
+    (no duplicated, no skipped step). ``stripe_index``/``stripe_count``
+    (v3+) narrow the stream to the residue class ``step % stripe_count ==
+    stripe_index`` — the fleet client's unit of spreading one shard across
+    N servers; the default ``0/1`` is the whole plan and is what every
+    pre-v3 exchange implicitly spoke. ``probe=True`` asks for HELLO_OK only
     (plan metadata, e.g. ``len(loader)``) with no batch stream.
     ``task_type``/``image_size``, when given, let the server reject a
     decode-config skew at connect time (a 224px server feeding a 299px
@@ -470,6 +546,8 @@ def hello(
         "seed": int(seed),
         "epoch": int(epoch),
         "start_step": int(start_step),
+        "stripe_index": int(stripe_index),
+        "stripe_count": int(stripe_count),
         "columns": list(columns) if columns is not None else None,
         "client_id": client_id,
         "probe": bool(probe),

@@ -87,6 +87,19 @@ class ServeConfig:
     metrics_host: str = "127.0.0.1"  # exporter bind address; /healthz leaks
     # dataset paths + peer addresses unauthenticated, so non-loopback
     # (0.0.0.0 behind a scrape network) is an explicit opt-in
+    coordinator_addr: Optional[str] = None  # host:port of a fleet
+    # Coordinator (`ldt coordinator`): register on start, heartbeat on a
+    # daemon thread, re-plan on lease changes, deregister on stop — this
+    # server becomes one stripe of an elastic fleet (README "Fleet").
+    # None = standalone single-server plane, exactly the pre-fleet behavior.
+    advertise_addr: Optional[str] = None  # the address CLIENTS dial, as
+    # registered with the coordinator. Defaults to host:bound-port, with a
+    # wildcard host replaced by this machine's hostname — set it explicitly
+    # whenever NAT/containers make the bind address undialable.
+    server_id: Optional[str] = None  # stable fleet identity; default is
+    # advertise_addr plus a random suffix (a restart is a new member)
+    heartbeat_interval_s: float = 0.0  # 0 = use the coordinator-advertised
+    # interval (CoordinatorConfig.heartbeat_interval_s)
 
 
 class _ClientSession:
@@ -161,6 +174,37 @@ class _ClientSession:
                     )},
                 )
                 return
+            # Striping (v3+): serve only the residue class
+            # step % stripe_count == stripe_index of [start, len(plan)) —
+            # the fleet client's unit of spreading one shard over N
+            # servers. Refused below STRIPE_MIN_VERSION: a client that
+            # thinks it striped against a server that ignored the fields
+            # would receive every step — silent fleet-wide duplication.
+            stripe_count = int(req.get("stripe_count") or 1)
+            stripe_index = int(req.get("stripe_index") or 0)
+            if stripe_count < 1 or not 0 <= stripe_index < stripe_count:
+                P.send_msg(
+                    self.sock, P.MSG_ERROR,
+                    {"message": (
+                        f"invalid stripe {stripe_index} of {stripe_count}"
+                    )},
+                )
+                return
+            if (stripe_count > 1
+                    and self.peer_version < P.STRIPE_MIN_VERSION):
+                P.send_msg(
+                    self.sock, P.MSG_ERROR,
+                    {"message": (
+                        "striping needs protocol >= "
+                        f"{P.STRIPE_MIN_VERSION}, negotiated "
+                        f"{self.peer_version}"
+                    )},
+                )
+                return
+            steps = [
+                s for s in range(start, len(plan))
+                if s % stripe_count == stripe_index
+            ]
             self.last_acked = start - 1
             P.send_msg(
                 self.sock, P.MSG_HELLO_OK,
@@ -168,18 +212,21 @@ class _ClientSession:
                 # vN+1 server answering a vN client must echo vN (what the
                 # stream actually speaks), or the client's range check on
                 # the echo rejects a connection the server just accepted.
+                # num_steps is the FULL plan length — the stripe's share is
+                # the client's arithmetic (it owns the merge).
                 {"version": self.peer_version, "num_steps": len(plan),
-                 "start_step": start},
+                 "start_step": start, "stripe_index": stripe_index,
+                 "stripe_count": stripe_count},
             )
-            if req.get("probe") or start == len(plan):
-                # Metadata-only connect (len(loader)), or an already-finished
-                # cursor: confirm completion, no stream.
+            if req.get("probe") or not steps:
+                # Metadata-only connect (len(loader)), or a cursor/stripe
+                # with nothing left to serve: confirm completion, no stream.
                 if not req.get("probe"):
                     P.send_msg(self.sock, P.MSG_END, {})
                 return
             if start > 0:
                 svc.counters.add("resumes")
-            self._stream(plan, start, req)
+            self._stream(plan, steps, req)
         except (ConnectionError, OSError, P.ProtocolError) as exc:
             # Client vanished or spoke garbage — count it, move on. Quiet
             # when the session (or the whole service) is already tearing
@@ -204,6 +251,17 @@ class _ClientSession:
         self.alive = False
         self._stop.set()
         try:
+            # shutdown BEFORE close: with the ack-reader (or sender) still
+            # blocked in a syscall on this fd, a bare close() only drops the
+            # fd-table entry — the kernel keeps the struct file alive for
+            # the blocked thread and never sends FIN, so the remote peer
+            # waits forever (exactly the server-crash path fleet failover
+            # must notice promptly). shutdown() signals the peer and wakes
+            # the blocked thread regardless of outstanding references.
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self.sock.close()
         except OSError:
             pass
@@ -211,10 +269,10 @@ class _ClientSession:
 
     # -- streaming ---------------------------------------------------------
 
-    def _stream(self, plan, start: int, req: dict) -> None:
+    def _stream(self, plan, steps, req: dict) -> None:
         svc = self.service
         producer = threading.Thread(
-            target=self._produce, args=(plan, start, req), daemon=True,
+            target=self._produce, args=(plan, steps, req), daemon=True,
             name=f"ldt-svc-produce-{self.peer}",
         )
         producer.start()
@@ -256,6 +314,13 @@ class _ClientSession:
                 # runs between sent_ns and the socket write, so encode CPU
                 # never masquerades as wire latency (mirror of the client
                 # stamping recv_ns before decode).
+                # Fault injection (fleet/chaos.py): the hook runs IN this
+                # send path so a scripted kill/stall lands on an exact
+                # batch count — determinism tests depend on it. None in
+                # production.
+                hook = svc.chaos
+                if hook is not None:
+                    hook("send", self.peer, step)
                 with span("svc.send", step=step, peer=self.peer):
                     if self.peer_version >= P.LINEAGE_MIN_VERSION:
                         lineage = dict(
@@ -300,8 +365,9 @@ class _ClientSession:
         if pool is not None and isinstance(item, tuple) and len(item) == 6:
             pool.release_batch(item[3])
 
-    def _produce(self, plan, start: int, req: dict) -> None:
-        """Decode plan items [start:] into the bounded queue, in order.
+    def _produce(self, plan, steps, req: dict) -> None:
+        """Decode the plan's ``steps`` (this session's cursor tail — or its
+        stripe's residue class of it) into the bounded queue, in order.
 
         Each batch is stamped at creation (``make_lineage``): plan step as
         ``batch_seq``, wall-clock ``created_ns``, and the measured
@@ -311,7 +377,7 @@ class _ClientSession:
         """
         svc = self.service
         try:
-            items = plan[start:]
+            items = [plan[s] for s in steps]
             if svc.workers is not None:
                 results = svc.workers.imap(items)
             else:
@@ -321,8 +387,7 @@ class _ClientSession:
                     for item in items
                 )
             it = iter(results)
-            for offset in range(len(items)):
-                step = start + offset
+            for step in steps:
                 if self._stop.is_set():
                     return
                 t0 = time.monotonic_ns()
@@ -421,6 +486,11 @@ class DataService:
         self.port: Optional[int] = None
         self._metrics = None  # MetricsHTTPServer when metrics_port is set
         self.metrics_port: Optional[int] = None  # bound exporter port
+        self.fleet_agent = None  # FleetAgent when coordinator_addr is set
+        # Test-only fault-injection hook (fleet/chaos.py): called by every
+        # sender thread as chaos("send", peer, step) before each batch
+        # frame. None (the production value) costs one attribute load.
+        self.chaos = None
 
     # -- data plane --------------------------------------------------------
 
@@ -553,7 +623,61 @@ class DataService:
             f"serving {self.config.dataset_path} on "
             f"{self.config.host}:{self.port}"
         )
+        if self.config.coordinator_addr:
+            # Fleet membership: register AFTER the listener is live (the
+            # advertised address must be dialable the moment the
+            # coordinator hands it to a client). The agent retries forever
+            # in the background — a coordinator that is still booting
+            # delays discovery, never this server.
+            from ..fleet.agent import FleetAgent
+
+            self.fleet_agent = FleetAgent(
+                self.config.coordinator_addr,
+                self._advertise_addr(),
+                server_id=self.config.server_id,
+                num_fragments=len(self.dataset.fragment_rows()),
+                on_lease_change=self._on_lease_change,
+                counters=self.counters,
+                heartbeat_interval_s=self.config.heartbeat_interval_s,
+            ).start()
+            self._log(
+                f"fleet member {self.fleet_agent.server_id} -> "
+                f"coordinator {self.config.coordinator_addr}"
+            )
         return self
+
+    def _advertise_addr(self) -> str:
+        """The address clients dial, as registered with the coordinator.
+        The bind host works unless it's a wildcard, where the machine's
+        hostname is the best guess — NAT/container setups should pass
+        ``advertise_addr`` explicitly."""
+        if self.config.advertise_addr:
+            return self.config.advertise_addr
+        host = self.config.host
+        if host in ("", "0.0.0.0", "::"):
+            host = socket.gethostname()
+        return f"{host}:{self.port}"
+
+    def _on_lease_change(self, lease: dict) -> None:
+        """Heartbeat/registration reported a new lease generation: the
+        fleet's membership moved, so this server's stripe of the fragment
+        space may have. Re-plan: drop the cached epoch plans (they rebuild
+        lazily per handshake — plan_for is a pure function, so streams in
+        flight are untouched) and publish the lease on the metrics
+        surface."""
+        with self._plans_lock:
+            self._plans.clear()
+        self.counters.gauge("lease_generation", lease.get("generation", 0))
+        self.counters.gauge("lease_stripe", lease.get("stripe_index", 0))
+        self.counters.gauge(
+            "lease_stripe_count", lease.get("stripe_count", 0)
+        )
+        self._log(
+            f"lease moved: generation {lease.get('generation')}, stripe "
+            f"{lease.get('stripe_index')}/{lease.get('stripe_count')}, "
+            f"fragments [{lease.get('fragment_lo')}, "
+            f"{lease.get('fragment_hi')})"
+        )
 
     def _healthz(self) -> dict:
         """Liveness extras for ``/healthz``: queue depths + client liveness
@@ -562,6 +686,16 @@ class DataService:
         with self._sessions_lock:
             sessions = list(self._sessions)
         stopped = self._stopped.is_set()
+        fleet = None
+        agent = self.fleet_agent  # snapshot: stop() nulls it concurrently
+        if agent is not None:
+            fleet = {
+                "coordinator": self.config.coordinator_addr,
+                "server_id": agent.server_id,
+                "registered": agent.registered.is_set(),
+                "lease": agent.lease,
+                "generation": agent.generation,
+            }
         return {
             # Non-"ok" serves as HTTP 503 (obs.http): a probe pointed here
             # sees the wind-down while the exporter thread lingers.
@@ -570,6 +704,7 @@ class DataService:
             "port": self.port,
             "active_clients": len(sessions),
             "stopped": stopped,
+            "fleet": fleet,
             "sessions": [
                 {
                     "peer": s.peer,
@@ -606,9 +741,16 @@ class DataService:
 
     def serve_forever(self) -> None:
         """Blocking serve (the ``ldt serve-data`` entry): start if needed,
-        then wait for stop()/KeyboardInterrupt, optionally logging stats."""
+        then wait for stop()/SIGTERM/KeyboardInterrupt, optionally logging
+        stats. SIGTERM (``docker stop``, k8s preemption) only sets the stop
+        flag; the ``finally`` here runs the real drain — sessions closed,
+        fleet lease deregistered, worker shm reaped, final counters
+        flushed — exactly as Ctrl-C always did."""
+        from ..utils.signals import install_sigterm_handler
+
         if self._sock is None:
             self.start()
+        install_sigterm_handler(self._stopped.set)
         try:
             interval = self.config.log_every_s
             while not self._stopped.wait(interval if interval > 0 else 3600.0):
@@ -618,13 +760,30 @@ class DataService:
             pass
         finally:
             self.stop()
+            # The final cursor/metrics flush an orchestrated shutdown used
+            # to skip: last-acked cursors per session are gone with the
+            # sockets, but the totals say what was served.
+            self._log(f"final {self.counters.snapshot()}")
 
     def stop(self) -> None:
         self._stopped.set()
+        if self.fleet_agent is not None:
+            # Graceful leave first: the coordinator reassigns the lease
+            # now, not at TTL expiry, so clients restripe immediately.
+            self.fleet_agent.stop()
+            self.fleet_agent = None
         if self._metrics is not None:
             self._metrics.stop()
             self._metrics = None
         if self._sock is not None:
+            try:
+                # Wake a concurrently-blocked accept() (see session close():
+                # a bare close can leave the kernel-side listener alive
+                # while the accept syscall holds the last reference, so
+                # in-flight dials would land in a backlog nobody drains).
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._sock.close()
             except OSError:

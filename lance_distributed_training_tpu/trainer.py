@@ -125,6 +125,13 @@ class TrainConfig:
     # process streams plan-ordered device-ready batches (RemoteLoader) —
     # identical batches to local training on the same seed. Iterable columnar
     # path only; decode knobs (task_type/image_size) must match server-side.
+    coordinator_addr: Optional[str] = None  # host:port of a running
+    # `ldt coordinator`: like data_service_addr, but the FleetLoader
+    # resolves N data servers from the coordinator, stripes this shard's
+    # plan across them, and fails over (re-stripe at the resume cursor) on
+    # server loss — same bit-identical batch contract, elastic capacity.
+    # Mutually exclusive with data_service_addr; NOT the jax multi-host
+    # rendezvous (that is coordinator_address, below).
     no_ddp: bool = False  # single-device escape hatch (lance_iterable.py:145)
     no_wandb: bool = False  # lance_iterable.py:146
     model_name: Optional[str] = None  # default per task (resnet50 / bert_base / clip)
@@ -560,19 +567,14 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
         mesh=mesh,
         seq_axis="seq" if config.seq_parallelism > 1 else None,
     )
-    if config.data_service_addr:
-        # Disaggregated input plane: decode runs in the remote DataService;
-        # this process only streams host batches and dispatches device_put.
-        # The server builds the identical epoch Plan (same make_plan), so
-        # batches match local training bit-for-bit on the same seed.
-        from .service.client import RemoteLoader
-
-        loader = RemoteLoader(
-            config.data_service_addr,
-            per_process,
-            process_index,
-            process_count,
-            put,
+    if config.data_service_addr or config.coordinator_addr:
+        # Disaggregated input plane: decode runs in remote DataService
+        # processes; this process only streams host batches and dispatches
+        # device_put. The servers build the identical epoch Plan (same
+        # make_plan), so batches match local training bit-for-bit on the
+        # same seed — whether one server (RemoteLoader) or a coordinated
+        # fleet striped across N of them (FleetLoader).
+        common = dict(
             sampler_type=config.sampler_type,
             shuffle=config.shuffle,
             seed=config.seed,
@@ -583,6 +585,28 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             image_size=config.image_size,
             buffer_pool=_loader_buffer_pool(config),
         )
+        if config.coordinator_addr:
+            from .fleet.balancer import FleetLoader
+
+            loader = FleetLoader(
+                config.coordinator_addr,
+                per_process,
+                process_index,
+                process_count,
+                put,
+                **common,
+            )
+        else:
+            from .service.client import RemoteLoader
+
+            loader = RemoteLoader(
+                config.data_service_addr,
+                per_process,
+                process_index,
+                process_count,
+                put,
+                **common,
+            )
         if len(loader) == 0:
             raise ValueError(
                 "empty plan from data service: dataset smaller than one "
@@ -862,17 +886,26 @@ def train(config: TrainConfig) -> dict:
                 "val_fraction needs the map-style columnar path (the split "
                 "is an index pool); pass loader_style='map'"
             )
-    if config.data_service_addr:
+    if config.data_service_addr and config.coordinator_addr:
+        raise ValueError(
+            "data_service_addr and coordinator_addr are mutually exclusive "
+            "(one names a single server, the other a fleet's coordinator)"
+        )
+    if config.data_service_addr or config.coordinator_addr:
+        remote_knob = (
+            "data_service_addr" if config.data_service_addr
+            else "coordinator_addr"
+        )
         if config.data_format != "columnar" or config.loader_style != "iterable":
             raise ValueError(
-                "data_service_addr needs the iterable columnar path (the "
+                f"{remote_knob} needs the iterable columnar path (the "
                 "service streams sampler-plan ranges); pass "
                 "loader_style='iterable', data_format='columnar'"
             )
         if config.filter or config.val_fraction:
             raise ValueError(
                 "filter/val_fraction resolve index pools locally and cannot "
-                "combine with data_service_addr"
+                f"combine with {remote_knob}"
             )
         if config.num_workers > 0:
             import warnings
@@ -900,7 +933,7 @@ def train(config: TrainConfig) -> dict:
 
     if config.data_format != "columnar":
         dataset = None
-    elif config.data_service_addr:
+    elif config.data_service_addr or config.coordinator_addr:
         # Disaggregated runs: the TPU host may not mount the dataset path at
         # all — train-side reads happen on the service host. Open locally
         # only if present (it unlocks eval + schedule-horizon derivation).
@@ -912,7 +945,7 @@ def train(config: TrainConfig) -> dict:
         dataset = Dataset(config.dataset_path)
     if (
         dataset is None
-        and config.data_service_addr
+        and (config.data_service_addr or config.coordinator_addr)
         and (config.eval_at_end or config.eval_every)
         and not config.val_dataset_path
     ):
@@ -961,7 +994,7 @@ def train(config: TrainConfig) -> dict:
             rows = len(index_pool)
         elif dataset is not None:
             rows = dataset.count_rows()
-        elif config.data_service_addr:
+        elif config.data_service_addr or config.coordinator_addr:
             raise ValueError(
                 "lr_schedule needs a horizon, and the dataset is not "
                 "readable on this host to derive one — pass total_steps "
@@ -1077,7 +1110,7 @@ def train(config: TrainConfig) -> dict:
                                     "steps": timer.steps},
             ).start()
             logger.log({"metrics_port": exporter.port}, to_wandb=False)
-        if not config.data_service_addr:
+        if not (config.data_service_addr or config.coordinator_addr):
             worker_pool = _make_worker_pool(config, dataset)
         return _train_loop(
             config, dataset, val_dataset, mesh, state, rng, train_step,

@@ -1,0 +1,37 @@
+"""SIGTERM → graceful stop, for the long-running serve entrypoints.
+
+``docker stop`` / k8s preemption deliver SIGTERM, not KeyboardInterrupt —
+before this helper the serve loops only caught the latter, so an
+orchestrated shutdown skipped session draining and the final cursor/metrics
+flush (and, worse, the worker-pool teardown that reaps ``/dev/shm``
+segments). The handler only sets a stop event: all real teardown stays in
+the serve loop's ``finally`` (signal handlers must not join threads or
+close sockets mid-interpreter-instruction).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+__all__ = ["install_sigterm_handler"]
+
+
+def install_sigterm_handler(callback: Callable[[], None]) -> bool:
+    """Run ``callback`` (idempotent, cheap — typically ``Event.set``) on
+    SIGTERM. Returns ``False`` where installation is impossible — not the
+    main thread (the ``signal`` module's rule; e.g. a service embedded in a
+    test), or a platform without SIGTERM — in which case callers keep the
+    KeyboardInterrupt-only behavior they had."""
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    try:
+        import signal
+
+        def _handler(signum, frame):  # noqa: ARG001 — signal signature
+            callback()
+
+        signal.signal(signal.SIGTERM, _handler)
+        return True
+    except (ValueError, OSError, AttributeError):
+        return False

@@ -12,7 +12,12 @@
 # Stage 3 — buffer-plane smoke (scripts/zc_smoke.py): shm-worker loopback,
 # asserts bufpool_hit_total > 0 / shm_batches_total > 0 via /metrics and
 # zero leaked /dev/shm segments after shutdown.
-# Stage 4 — the tier-1 verify command from ROADMAP.md, verbatim.
+# Stage 4 — fleet smoke (scripts/fleet_smoke.py): coordinator + 2 real
+# serve-data subprocesses, SIGKILL one mid-stream — the striped client
+# stream must complete bit-identical with fleet_failovers_total >= 1, the
+# coordinator must expire the corpse, the survivor must drain on SIGTERM
+# with exit 0, and /dev/shm must end clean.
+# Stage 5 — the tier-1 verify command from ROADMAP.md, verbatim.
 set -e
 cd "$(dirname "$0")/.."
 
@@ -82,6 +87,12 @@ echo "== buffer-plane smoke (shm workers + pooled pages) =="
 # script file, not a heredoc: spawn workers re-import __main__, which must
 # be an importable path.
 timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/zc_smoke.py
+
+echo "== fleet smoke (coordinator + 2 servers, SIGKILL mid-stream) =="
+# Real subprocess members (the `ldt serve-data --coordinator` CLI path) so
+# the SIGKILL is a genuine process death and the SIGTERM drain is the real
+# docker-stop path, not an in-process simulation.
+timeout -k 10 420 env JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/fleet_smoke.py
 
 echo "== tier-1 tests =="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

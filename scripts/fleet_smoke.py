@@ -1,0 +1,179 @@
+"""CI fleet smoke: coordinator + 2 real `ldt serve-data` subprocesses,
+SIGKILL one mid-stream, assert the striped client stream completes
+bit-identical with fleet_failovers_total >= 1, the coordinator expires the
+corpse, the survivor drains cleanly on SIGTERM (exit 0), and no /dev/shm
+segment outlives the run.
+
+Equivalent by hand:
+    ldt coordinator --host 127.0.0.1 --port 8470 &
+    ldt serve-data --dataset_path <ds> --coordinator 127.0.0.1:8470 &  # x2
+    ldt train --dataset_path <ds> --coordinator 127.0.0.1:8470 ...
+    kill -9 <one serve-data pid>   # mid-epoch
+    kill <the other>               # SIGTERM: graceful drain
+
+Run as a real script (spawned decode workers re-import __main__):
+    PYTHONPATH=. python scripts/fleet_smoke.py
+"""
+
+import io
+import os
+import pathlib
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+from PIL import Image
+
+
+def main() -> None:
+    from lance_distributed_training_tpu.data import (
+        ImageClassificationDecoder,
+        write_dataset,
+    )
+    from lance_distributed_training_tpu.data.pipeline import (
+        make_train_pipeline,
+    )
+    from lance_distributed_training_tpu.fleet import (
+        Coordinator,
+        CoordinatorConfig,
+        FleetLoader,
+    )
+
+    shm_before = set(os.listdir("/dev/shm")) if os.path.isdir(
+        "/dev/shm"
+    ) else set()
+
+    rng = np.random.default_rng(0)
+
+    def jpeg() -> bytes:
+        arr = (rng.random((32, 32, 3)) * 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG")
+        return buf.getvalue()
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="ldt-ci-fleet-"))
+    procs: list = []
+    coord = None
+    try:
+        # Sized so one stripe (30 steps x ~100 KB decoded batches ~ 3 MB)
+        # can NOT hide in TCP/queue buffers: at the kill there are always
+        # undelivered steps on the dead member, so failover genuinely runs
+        # (a 12-step smoke completed out of buffered frames without ever
+        # re-dialing — asserting nothing).
+        table = pa.table({
+            "image": pa.array([jpeg() for _ in range(480)], pa.binary()),
+            "label": pa.array(rng.integers(0, 10, 480), pa.int64()),
+        })
+        ds = write_dataset(table, tmp / "ds", mode="create",
+                           max_rows_per_file=120)
+        ref = list(make_train_pipeline(
+            ds, "batch", 8, 0, 1, ImageClassificationDecoder(image_size=64),
+        ))
+
+        coord = Coordinator(CoordinatorConfig(
+            host="127.0.0.1", port=0, heartbeat_interval_s=0.25,
+            lease_ttl_s=2.0, metrics_port=0,
+        )).start()
+        caddr = f"127.0.0.1:{coord.port}"
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.getcwd())
+        # Member 1 gets a worker process + shm IPC so the shutdown path
+        # that reaps /dev/shm is exercised end-to-end; member 0 (the one
+        # we SIGKILL) decodes in-thread so the corpse leaves nothing.
+        for extra in ([], ["--num_workers", "1"]):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "lance_distributed_training_tpu.cli",
+                 "serve-data", "--dataset_path", str(ds.uri),
+                 "--host", "127.0.0.1", "--port", "0", "--image_size", "64",
+                 "--queue_depth", "2",
+                 "--coordinator", caddr, "--log_every_s", "0", *extra],
+                env=env,
+            ))
+
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if coord._healthz()["stripe_count"] == 2:
+                break
+            for p in procs:
+                if p.poll() is not None:
+                    raise SystemExit(
+                        f"serve-data exited early: {p.returncode}"
+                    )
+            time.sleep(0.2)
+        else:
+            raise SystemExit("members never registered")
+        print("[smoke] 2 members registered")
+
+        loader = FleetLoader(caddr, 8, 0, 1,
+                             connect_retries=3, backoff_s=0.1)
+        got = []
+        for batch in loader:
+            got.append(batch)
+            if len(got) == 2:
+                procs[0].kill()  # SIGKILL, mid-stream
+                procs[0].wait(timeout=30)
+                print("[smoke] SIGKILLed member", procs[0].pid)
+        assert len(got) == len(ref), (len(got), len(ref))
+        for i, (a, b) in enumerate(zip(got, ref)):
+            np.testing.assert_array_equal(a["image"], b["image"],
+                                          err_msg=f"step {i}")
+            np.testing.assert_array_equal(a["label"], b["label"],
+                                          err_msg=f"step {i}")
+        snap = loader.counters.snapshot()
+        assert snap.get("fleet_failovers_total", 0) >= 1, snap
+        print(f"[smoke] stream bit-identical across SIGKILL, "
+              f"failovers={snap['fleet_failovers_total']:.0f}")
+
+        # The coordinator notices the corpse at TTL and reassigns.
+        while time.monotonic() < deadline:
+            if coord._healthz()["stripe_count"] == 1:
+                break
+            time.sleep(0.2)
+        assert coord._healthz()["stripe_count"] == 1, "corpse never expired"
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{coord.metrics_port}/metrics", timeout=10
+        ).read().decode()
+        for series in ("fleet_members 1", "fleet_expirations_total",
+                       "fleet_lease_generation"):
+            assert series in metrics, f"missing {series} in /metrics"
+        print("[smoke] coordinator expired the corpse; metrics healthy")
+
+        # SIGTERM the survivor: serve_forever's handler must drain and
+        # exit 0 (the docker-stop/k8s path), reaping its shm worker.
+        procs[1].send_signal(signal.SIGTERM)
+        assert procs[1].wait(timeout=60) == 0, procs[1].returncode
+        print("[smoke] survivor drained cleanly on SIGTERM (exit 0)")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                # terminate (SIGTERM), not kill: a SIGKILLed server orphans
+                # its spawn workers and their shm segments, which would
+                # turn one failed assertion into a second, misleading one.
+                p.terminate()
+                try:
+                    p.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout=30)
+        if coord is not None:
+            coord.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    shm_after = set(os.listdir("/dev/shm")) if os.path.isdir(
+        "/dev/shm"
+    ) else set()
+    leaked = shm_after - shm_before
+    assert not leaked, f"leaked /dev/shm segments: {sorted(leaked)}"
+    print("[smoke] fleet smoke ok: failover, expiry, SIGTERM drain, "
+          "no shm leaks")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,564 @@
+"""Elastic data-plane fleet: coordinator membership + leases, striped
+FleetLoader parity with the single-server plane, and failover under
+deterministic chaos (kill / stall / partition).
+
+All fast (`not slow`): coordinator + member servers run in-thread on
+127.0.0.1 with tiny 32px batches — the same loopback harness as
+tests/test_service.py, extended to N servers.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lance_distributed_training_tpu.data import ImageClassificationDecoder
+from lance_distributed_training_tpu.data.pipeline import make_train_pipeline
+from lance_distributed_training_tpu.fleet import (
+    Coordinator,
+    CoordinatorConfig,
+    FleetLoader,
+)
+from lance_distributed_training_tpu.fleet.chaos import ChaosController
+from lance_distributed_training_tpu.service import (
+    DataService,
+    ServeConfig,
+)
+from lance_distributed_training_tpu.service import protocol as P
+
+STEPS = 240 // 16  # image_dataset rows / batch size
+
+
+# -- fixtures ---------------------------------------------------------------
+
+
+@pytest.fixture()
+def coordinator():
+    coord = Coordinator(CoordinatorConfig(
+        host="127.0.0.1", port=0,
+        heartbeat_interval_s=0.1, lease_ttl_s=0.6,
+    )).start()
+    yield coord
+    coord.stop()
+
+
+def _member(image_dataset, coordinator, **kw):
+    svc = DataService(ServeConfig(
+        dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+        image_size=32, queue_depth=2,
+        coordinator_addr=f"127.0.0.1:{coordinator.port}",
+        **kw,
+    )).start()
+    assert svc.fleet_agent.registered.wait(5), "registration timed out"
+    return svc
+
+
+@pytest.fixture()
+def fleet(image_dataset, coordinator):
+    """Coordinator + 2 registered member servers."""
+    servers = [_member(image_dataset, coordinator) for _ in range(2)]
+    yield coordinator, servers
+    for s in servers:
+        s.stop()
+
+
+def _fleet_loader(coordinator, **kw):
+    kw.setdefault("connect_retries", 2)
+    kw.setdefault("resolve_retries", 3)
+    kw.setdefault("backoff_s", 0.05)
+    return FleetLoader(f"127.0.0.1:{coordinator.port}", 16, 0, 1, **kw)
+
+
+def _local_batches(image_dataset):
+    return list(make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1,
+        ImageClassificationDecoder(image_size=32),
+    ))
+
+
+def _assert_stream_identical(got, ref):
+    assert len(got) == len(ref), (len(got), len(ref))
+    for i, (a, b) in enumerate(zip(got, ref)):
+        np.testing.assert_array_equal(a["image"], b["image"],
+                                      err_msg=f"step {i}")
+        np.testing.assert_array_equal(a["label"], b["label"],
+                                      err_msg=f"step {i}")
+
+
+# -- address parsing (the IPv6 satellite) -----------------------------------
+
+
+def test_parse_hostport_forms():
+    assert P.parse_hostport("host:8476") == ("host", 8476)
+    assert P.parse_hostport("10.0.0.2:1") == ("10.0.0.2", 1)
+    assert P.parse_hostport(":8476") == ("127.0.0.1", 8476)
+    assert P.parse_hostport("[::1]:8476") == ("::1", 8476)
+    assert P.parse_hostport("[fe80::1%eth0]:99") == ("fe80::1%eth0", 99)
+
+
+@pytest.mark.parametrize("bad", [
+    "nonsense", "host:", "host:port", "::1:8476", "[]:8476", "[::1]", "",
+])
+def test_parse_hostport_rejects(bad):
+    with pytest.raises(ValueError):
+        P.parse_hostport(bad)
+
+
+# -- coordinator membership + leases ----------------------------------------
+
+
+def test_register_resolve_deregister(image_dataset, coordinator):
+    assert coordinator.generation == 0
+    s1 = _member(image_dataset, coordinator)
+    s2 = _member(image_dataset, coordinator)
+    try:
+        health = coordinator._healthz()
+        assert health["stripe_count"] == 2
+        assert coordinator.generation == 2  # one bump per join
+        ids = {m["server_id"] for m in health["members"]}
+        assert ids == {s1.fleet_agent.server_id, s2.fleet_agent.server_id}
+        # Leases are disjoint stripes over the fragment space.
+        stripes = sorted(m["stripe_index"] for m in health["members"])
+        assert stripes == [0, 1]
+        frags = sorted(
+            (m["fragment_lo"], m["fragment_hi"]) for m in health["members"]
+        )
+        assert frags[0][1] == frags[1][0]  # contiguous, non-overlapping
+        assert frags[0][0] == 0
+    finally:
+        s1.stop()
+    # Graceful stop deregisters immediately — no TTL wait.
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if coordinator._healthz()["stripe_count"] == 1:
+            break
+        time.sleep(0.02)
+    assert coordinator._healthz()["stripe_count"] == 1
+    s2.stop()
+
+
+def test_heartbeat_expiry_reassigns_lease(image_dataset, coordinator):
+    """A member that goes silent (partition) is expired at TTL, the
+    generation bumps, and the survivor's lease grows to the whole space."""
+    s1 = _member(image_dataset, coordinator)
+    s2 = _member(image_dataset, coordinator)
+    try:
+        gen = coordinator.generation
+        ChaosController(s1).partition()  # heartbeats pause, data plane up
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if coordinator._healthz()["stripe_count"] == 1:
+                break
+            time.sleep(0.02)
+        health = coordinator._healthz()
+        assert health["stripe_count"] == 1
+        assert coordinator.generation > gen
+        survivor = health["members"][0]
+        assert survivor["server_id"] == s2.fleet_agent.server_id
+        assert (survivor["fragment_lo"], survivor["fragment_hi"]) == (
+            0, len(image_dataset.fragment_rows())
+        )
+        # Healing the partition re-registers on the unknown-member answer.
+        ChaosController(s1).heal()
+        while time.monotonic() < deadline:
+            if coordinator._healthz()["stripe_count"] == 2:
+                break
+            time.sleep(0.02)
+        assert coordinator._healthz()["stripe_count"] == 2
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_lease_change_replans_server(image_dataset, coordinator):
+    """A membership change invalidates members' cached epoch plans (the
+    re-plan-on-lease-change hook) and lands on the metrics surface."""
+    s1 = _member(image_dataset, coordinator)
+    try:
+        # Prime the plan cache with a handshake.
+        loader = _fleet_loader(coordinator)
+        assert len(loader) == STEPS
+        assert s1._plans
+        gen1 = s1.fleet_agent.generation
+        s2 = _member(image_dataset, coordinator)
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if s1.fleet_agent.generation > gen1 and not s1._plans:
+                    break
+                time.sleep(0.02)
+            assert s1.fleet_agent.generation > gen1
+            with s1._plans_lock:
+                assert not s1._plans  # dropped; rebuilt lazily per handshake
+            snap = s1.counters.snapshot()
+            assert snap["svc_lease_stripe_count"] == 2
+        finally:
+            s2.stop()
+    finally:
+        s1.stop()
+
+
+def test_coordinator_metrics_and_healthz(image_dataset):
+    import json as _json
+    import urllib.request
+
+    coord = Coordinator(CoordinatorConfig(
+        host="127.0.0.1", port=0, heartbeat_interval_s=0.1,
+        lease_ttl_s=0.6, metrics_port=0,
+    )).start()
+    svc = None
+    try:
+        svc = _member(image_dataset, coord)
+        base = f"http://127.0.0.1:{coord.metrics_port}"
+        text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        for series in ("fleet_members", "fleet_lease_generation",
+                       "fleet_registrations_total",
+                       "fleet_rebalance_ms_bucket"):
+            assert series in text, f"missing {series}"
+        health = _json.loads(
+            urllib.request.urlopen(f"{base}/healthz").read()
+        )
+        assert health["status"] == "ok"
+        assert health["stripe_count"] == 1
+        assert health["members"][0]["addr"].startswith("127.0.0.1:")
+    finally:
+        if svc is not None:
+            svc.stop()
+        coord.stop()
+
+
+def test_heartbeat_from_unknown_member_gets_marker():
+    from lance_distributed_training_tpu.fleet.coordinator import (
+        UNKNOWN_MEMBER_MARKER,
+    )
+
+    coord = Coordinator(CoordinatorConfig(host="127.0.0.1", port=0)).start()
+    try:
+        with socket.create_connection(("127.0.0.1", coord.port)) as sock:
+            P.send_msg(sock, P.MSG_FLEET_HEARTBEAT, {"server_id": "ghost"})
+            msg_type, reply = P.recv_msg(sock)
+        assert msg_type == P.MSG_ERROR
+        assert UNKNOWN_MEMBER_MARKER in reply["message"]
+    finally:
+        coord.stop()
+
+
+# -- striped streaming (protocol v3) ----------------------------------------
+
+
+def test_stripe_handshake_serves_residue_class(image_dataset, fleet):
+    """Raw v3 stripe HELLO: the server streams exactly the steps of the
+    requested residue class, in order, with global step numbering."""
+    _, servers = fleet
+    sock = socket.create_connection(("127.0.0.1", servers[0].port))
+    try:
+        P.send_msg(sock, P.MSG_HELLO, P.hello(
+            batch_size=16, process_index=0, process_count=1,
+            start_step=3, stripe_index=1, stripe_count=3,
+        ))
+        msg_type, reply = P.recv_msg(sock)
+        assert msg_type == P.MSG_HELLO_OK
+        assert reply["num_steps"] == STEPS  # the FULL plan length
+        assert reply["stripe_index"] == 1 and reply["stripe_count"] == 3
+        steps = []
+        while True:
+            msg_type, payload = P.recv_msg(sock)
+            if msg_type == P.MSG_END:
+                break
+            step, _ = P.decode_batch(payload["raw"])
+            steps.append(step)
+        assert steps == [s for s in range(3, STEPS) if s % 3 == 1]
+    finally:
+        sock.close()
+
+
+def test_stripe_refused_below_v3(image_dataset, fleet):
+    """A v2 peer asking for stripes must be refused — an old server would
+    ignore the fields and serve every step (silent duplication), so the
+    new server refuses the mirror-image skew loudly."""
+    _, servers = fleet
+    sock = socket.create_connection(("127.0.0.1", servers[0].port))
+    try:
+        req = P.hello(batch_size=16, process_index=0, process_count=1,
+                      stripe_index=0, stripe_count=2)
+        req["version"] = 2
+        P.send_msg(sock, P.MSG_HELLO, req)
+        msg_type, reply = P.recv_msg(sock)
+        assert msg_type == P.MSG_ERROR
+        assert "striping" in reply["message"]
+    finally:
+        sock.close()
+
+
+def test_fleet_loader_matches_inprocess_pipeline(image_dataset, fleet):
+    """Acceptance: 2-server striped stream element-wise identical to the
+    in-process pipeline (and so to a single-server RemoteLoader)."""
+    coordinator, _ = fleet
+    ref = _local_batches(image_dataset)
+    loader = _fleet_loader(coordinator)
+    assert len(loader) == len(ref) == STEPS
+    _assert_stream_identical(list(loader), ref)
+    snap = loader.counters.snapshot()
+    assert snap["fleet_stripes"] == 2
+    assert snap["fleet_batches_received"] == STEPS
+    assert snap.get("fleet_failovers_total", 0) == 0
+
+
+def test_fleet_loader_shards_disjoint(image_dataset, fleet):
+    coordinator, _ = fleet
+    streams = []
+    for p in range(2):
+        loader = FleetLoader(
+            f"127.0.0.1:{coordinator.port}", 16, p, 2,
+            connect_retries=2, resolve_retries=3, backoff_s=0.05,
+        )
+        streams.append([tuple(b["label"].tolist()) for b in loader])
+    assert len(streams[0]) == len(streams[1]) > 0
+    assert not (set(streams[0]) & set(streams[1]))
+
+
+def test_fleet_loader_epoch_reshuffle(image_dataset, fleet):
+    coordinator, _ = fleet
+
+    def local(epoch):
+        pipe = make_train_pipeline(
+            image_dataset, "batch", 16, 0, 1,
+            ImageClassificationDecoder(image_size=32),
+            shuffle=True, seed=7, epoch=epoch,
+        )
+        return [tuple(b["label"].tolist()) for b in pipe]
+
+    loader = _fleet_loader(coordinator, shuffle=True, seed=7)
+    e0 = [tuple(b["label"].tolist()) for b in loader]
+    loader.set_epoch(1)
+    e1 = [tuple(b["label"].tolist()) for b in loader]
+    assert e0 == local(0)
+    assert e1 == local(1)
+    assert e0 != e1
+
+
+# -- failover (the tentpole's acceptance) -----------------------------------
+
+
+def test_kill_mid_epoch_stream_bit_identical(image_dataset, fleet):
+    """Acceptance: with 2 servers and buffer_pool on, killing one after
+    exactly 3 sent batches yields the identical batch sequence (bit-identical
+    tensors, no gaps, no duplicates) as an uninterrupted run, and the
+    failover is counted."""
+    from lance_distributed_training_tpu.data.buffers import BufferPool
+
+    coordinator, servers = fleet
+    assert all(s.buffer_pool is not None for s in servers)  # pool is on
+    ref = _local_batches(image_dataset)
+    chaos = ChaosController(servers[0]).kill_after(3)
+    loader = _fleet_loader(coordinator, buffer_pool=BufferPool())
+    got = []
+    for batch in loader:
+        # Copy out: the pool recycles pages after the consumer moves on.
+        got.append({k: np.array(v, copy=True) for k, v in batch.items()})
+        loader.buffer_pool.release_batch(batch)
+    assert chaos.killed.is_set()
+    _assert_stream_identical(got, ref)
+    snap = loader.counters.snapshot()
+    assert snap["fleet_failovers_total"] >= 1
+    assert snap["fleet_batches_received"] >= STEPS  # re-striped tail
+
+
+def test_kill_after_resume_cursor_zero(image_dataset, fleet):
+    """Kill before the first batch is consumed: the whole plan restripes
+    from step 0 over the survivor — still no loss, no duplication."""
+    coordinator, servers = fleet
+    ref = _local_batches(image_dataset)
+    chaos = ChaosController(servers[1]).kill_after(0)
+    loader = _fleet_loader(coordinator)
+    got = list(loader)
+    assert chaos.killed.is_set()
+    _assert_stream_identical(got, ref)
+    assert loader.counters.snapshot()["fleet_failovers_total"] >= 1
+
+
+def test_stall_is_not_failover(image_dataset, fleet):
+    """A slow server must NOT trigger failover (no mid-stream deadline —
+    the livelock guard): the stream just waits and stays identical."""
+    coordinator, servers = fleet
+    ref = _local_batches(image_dataset)
+    chaos = ChaosController(servers[0]).stall_after(2, 0.5)
+    loader = _fleet_loader(coordinator)
+    got = list(loader)
+    assert chaos.wait_stalled(0.1)  # the stall actually happened
+    _assert_stream_identical(got, ref)
+    assert loader.counters.snapshot().get("fleet_failovers_total", 0) == 0
+
+
+def test_fleet_of_one_still_serves(image_dataset, coordinator):
+    svc = _member(image_dataset, coordinator)
+    try:
+        ref = _local_batches(image_dataset)
+        loader = _fleet_loader(coordinator)
+        _assert_stream_identical(list(loader), ref)
+        assert loader.counters.snapshot()["fleet_stripes"] == 1
+    finally:
+        svc.stop()
+
+
+def test_empty_fleet_raises_after_retries(coordinator):
+    loader = _fleet_loader(coordinator, resolve_retries=2, backoff_s=0.01)
+    with pytest.raises(ConnectionError, match="membership"):
+        len(loader)
+
+
+def test_unreachable_coordinator_raises():
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    loader = FleetLoader(
+        f"127.0.0.1:{port}", 16, 0, 1,
+        connect_retries=1, resolve_retries=2, backoff_s=0.01,
+    )
+    with pytest.raises(ConnectionError):
+        len(loader)
+
+
+# -- SIGTERM wiring (satellite) ---------------------------------------------
+
+
+def test_sigterm_handler_sets_stop():
+    """The serve loops' SIGTERM handler: installable from the main thread,
+    a real delivered SIGTERM runs the callback (so docker stop drains the
+    serve loop), and the previous disposition is restorable."""
+    import signal
+
+    from lance_distributed_training_tpu.utils.signals import (
+        install_sigterm_handler,
+    )
+
+    fired = threading.Event()
+    previous = signal.getsignal(signal.SIGTERM)
+    try:
+        assert install_sigterm_handler(fired.set) is True
+        signal.raise_signal(signal.SIGTERM)
+        assert fired.is_set()
+    finally:
+        signal.signal(signal.SIGTERM, previous)
+
+
+def test_sigterm_handler_refused_off_main_thread():
+    from lance_distributed_training_tpu.utils.signals import (
+        install_sigterm_handler,
+    )
+
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(install_sigterm_handler(lambda: None))
+    )
+    t.start()
+    t.join()
+    assert results == [False]
+
+
+def test_serve_forever_drains_on_stop(image_dataset):
+    """serve_forever (the SIGTERM/KeyboardInterrupt path's finally) tears
+    everything down through stop(): sessions, fleet agent, listener."""
+    svc = DataService(ServeConfig(
+        dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+        image_size=32,
+    )).start()
+    t = threading.Thread(target=svc.serve_forever, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    svc._stopped.set()  # what the SIGTERM handler does
+    t.join(timeout=10)
+    assert not t.is_alive()
+    # Listener is really gone.
+    with pytest.raises(OSError):
+        socket.create_connection(("127.0.0.1", svc.port), timeout=0.5)
+
+
+# -- trainer wiring ---------------------------------------------------------
+
+
+def test_train_config_coordinator_validation():
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        train(TrainConfig(
+            dataset_path="/nonexistent", no_wandb=True,
+            data_service_addr="h:1", coordinator_addr="h:2",
+        ))
+    with pytest.raises(ValueError, match="iterable columnar"):
+        train(TrainConfig(
+            dataset_path="/nonexistent", no_wandb=True,
+            coordinator_addr="h:2", loader_style="map",
+        ))
+
+
+def test_train_cli_coordinator_flag(monkeypatch):
+    import lance_distributed_training_tpu.cli as cli
+
+    captured = {}
+    monkeypatch.setattr(
+        cli, "train", lambda config: captured.update(config=config) or {}
+    )
+    cli.main(["train", "--dataset_path", "/d", "--no_wandb",
+              "--coordinator", "coord-host:8470"])
+    assert captured["config"].coordinator_addr == "coord-host:8470"
+    assert captured["config"].data_service_addr is None
+
+
+def test_coordinator_cli_parser_roundtrip():
+    from lance_distributed_training_tpu.cli import build_coordinator_parser
+
+    args = build_coordinator_parser().parse_args([
+        "--port", "0", "--lease_ttl_s", "3.5", "--metrics_port", "0",
+    ])
+    assert args.port == 0 and args.lease_ttl_s == 3.5
+    assert args.metrics_port == 0
+
+
+def test_serve_cli_coordinator_flags():
+    from lance_distributed_training_tpu.cli import build_serve_parser
+
+    args = build_serve_parser().parse_args([
+        "--dataset_path", "/d", "--coordinator", "c:8470",
+        "--advertise_addr", "10.0.0.9:8476",
+    ])
+    assert args.coordinator == "c:8470"
+    assert args.advertise_addr == "10.0.0.9:8476"
+    # Standalone (no coordinator) stays the default.
+    args = build_serve_parser().parse_args(["--dataset_path", "/d"])
+    assert args.coordinator is None
+
+
+@pytest.mark.slow
+def test_train_through_fleet(image_dataset):
+    """Full trainer integration: train() with coordinator_addr streams every
+    batch through a 2-server fleet (resnet18 compile — slow tier)."""
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    coord = Coordinator(CoordinatorConfig(
+        host="127.0.0.1", port=0, heartbeat_interval_s=0.1,
+        lease_ttl_s=0.6,
+    )).start()
+    servers = []
+    try:
+        servers = [_member(image_dataset, coord) for _ in range(2)]
+        results = train(TrainConfig(
+            dataset_path=image_dataset.uri,
+            coordinator_addr=f"127.0.0.1:{coord.port}",
+            num_classes=10, model_name="resnet18", image_size=32,
+            batch_size=16, epochs=1, no_wandb=True, eval_at_end=False,
+        ))
+        assert np.isfinite(results["loss"])
+        assert results["steps"] == STEPS
+        sent = sum(
+            s.counters.snapshot().get("svc_batches_sent", 0)
+            for s in servers
+        )
+        assert sent >= STEPS
+    finally:
+        for s in servers:
+            s.stop()
+        coord.stop()

@@ -530,6 +530,16 @@ def test_bad_address_rejected_eagerly():
         RemoteLoader("nonsense", 16, 0, 1)
 
 
+def test_ipv6_address_parsed_not_mangled():
+    """Bracketed IPv6 must parse as the literal host — the old bare
+    rpartition(":") yielded host '[::1' and dialed garbage."""
+    loader = RemoteLoader("[::1]:8476", 16, 0, 1)
+    assert (loader.host, loader.port) == ("::1", 8476)
+    # Unbracketed multi-colon literals are ambiguous, not silently split.
+    with pytest.raises(ValueError, match="bracket"):
+        RemoteLoader("::1:8476", 16, 0, 1)
+
+
 # -- trainer config validation ---------------------------------------------
 
 
