@@ -126,8 +126,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "(bert_*: 30522, clip_tiny: 1000)")
     p.add_argument("--prefetch", type=int, default=2)
     p.add_argument("--producer_threads", type=int, default=4,
-                   help="decode-producer threads (cross-batch decode + "
-                        "H2D overlap)")
+                   help="decode-producer threads (cross-batch decode "
+                        "overlap; with --no_global_batch they also "
+                        "pipeline the per-batch H2D copy)")
+    p.add_argument("--placement_depth", type=int, default=2,
+                   help="device-resident global batches the placement "
+                        "plane keeps transferred ahead of the step "
+                        "(default 2 = double-buffered H2D)")
+    p.add_argument("--no_global_batch", action="store_true",
+                   help="disable the async placement plane: assemble the "
+                        "global batch with a synchronous device_put on the "
+                        "consumer thread (pre-r7 control arm; batches stay "
+                        "bit-identical, H2D lands inside loader stall)")
     p.add_argument("--data_echo", type=int, default=1,
                    help=">1: run N train steps per host batch with fresh "
                         "on-device augmentation each echo (data echoing) — "
@@ -178,6 +188,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fsdp", action="store_true",
                    help="fully shard params + optimizer state over the "
                         "'data' axis (ZeRO-3 equivalent)")
+    p.add_argument("--zero", action="store_true",
+                   help="shard ONLY the optimizer state over the 'data' "
+                        "axis, params replicated (ZeRO-1: optimizer memory "
+                        "scales 1/N with the mesh, no per-layer gathers; "
+                        "mutually exclusive with --fsdp)")
     p.add_argument("--num_experts", type=int, default=0,
                    help=">0: switch-MoE transformer blocks; experts shard "
                         "over the 'model' mesh axis (expert parallelism)")
@@ -475,6 +490,7 @@ def main(argv=None) -> dict:
         grad_clip=args.grad_clip,
         grad_accum=args.grad_accum,
         fsdp=args.fsdp,
+        zero_opt=args.zero,
         num_workers=args.num_workers,
         shm_workers=not args.no_shm_workers,
         buffer_pool=not args.no_buffer_pool,
@@ -491,6 +507,8 @@ def main(argv=None) -> dict:
         vocab_size=args.vocab_size,
         prefetch=args.prefetch,
         producer_threads=args.producer_threads,
+        global_batch=not args.no_global_batch,
+        placement_depth=args.placement_depth,
         data_echo=args.data_echo,
         device_cache=args.device_cache,
         device_cache_gb=args.device_cache_gb,

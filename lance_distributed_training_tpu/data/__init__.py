@@ -34,4 +34,5 @@ from .authoring import (  # noqa: F401
 )
 from .filters import parse_predicate, predicate_mask  # noqa: F401
 from .folder import FolderDataPipeline  # noqa: F401
+from .placement import PlacedLoader, PlacementPlane  # noqa: F401
 from .workers import WorkerPool, columnar_spec, folder_spec  # noqa: F401

@@ -207,6 +207,17 @@ class BufferPool:
             return 0
         return sum(self.release(v) for v in list(batch.values()))
 
+    def sweep(self) -> None:
+        """Run one pending→free sweep now. The sweep normally rides every
+        ``lease``/``release``; the placement plane's release-at-dispatch
+        discipline means the LAST batches of an epoch can sit on the
+        pending list until jax drops its transfer references — a steady
+        state the next lease clears, but teardown paths and leak asserts
+        (tests, the CI smoke) call this to observe 'everything recycled'
+        without having to lease again."""
+        with self._lock:
+            self._sweep_locked()
+
     def stats(self) -> dict:
         with self._lock:
             return {

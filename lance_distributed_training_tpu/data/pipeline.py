@@ -11,11 +11,15 @@ the reference's:
   multi-worker loading (map-style path, ``lance_map_style.py:54-69``).
 
 TPU-native design: a background producer thread walks this process's read
-plan, fans decode out over a thread pool, and fills a bounded queue; the
-consumer turns each host batch into a **global** ``jax.Array`` sharded
-``P('data')`` over the mesh (``make_global_batch``), so the H2D DMA for step
-N+1 overlaps the device compute of step N. That overlap — not a faster
-kernel — is what drives loader-stall below the 2% BASELINE target.
+plan, fans decode out over a thread pool, and fills a bounded queue of HOST
+batches; placement to the device mesh is owned by the shared **placement
+plane** (:mod:`.placement`) — the trainer wraps every pipeline in a
+``PlacedLoader`` whose dedicated thread slices per local device, dispatches
+async H2D, and double-buffers device-resident global batches, so the DMA
+for step N+1 overlaps the device compute of step N. That overlap — not a
+faster kernel — is what drives loader-stall below the 2% BASELINE target.
+The ``device_put_fn`` parameter remains as the synchronous escape hatch
+(the ``--no_global_batch`` control arm, and direct library callers).
 
 Thread & queue policy (enforced by ``ldt check`` LDT201/LDT202): producer
 threads are ``daemon=True`` (a wedged decode must never block interpreter
@@ -96,7 +100,10 @@ class DataPipeline:
     decode_fn: Table → dict of host numpy arrays (the ``to_tensor_fn`` /
         ``collate_fn`` plugin point, ``/root/reference/README.md:28,60``).
     device_put_fn: host batch dict → device batch (a closure over
-        ``make_global_batch(mesh)``); ``None`` yields host numpy batches.
+        ``make_global_batch(mesh)``), run synchronously on the consumer
+        thread; ``None`` yields host numpy batches — the default since r7,
+        where the placement plane (:mod:`.placement`) owns H2D on its own
+        thread downstream of this pipeline.
     prefetch: queue depth of decoded batches kept ahead of the consumer.
     producers: number of producer threads decoding plan items concurrently
         (results still yielded in plan order). With one producer there is no
@@ -201,9 +208,10 @@ class DataPipeline:
 
             warnings.warn(
                 "producers>1 has no effect with a WorkerPool: worker "
-                "processes already decode in parallel and device_put runs "
-                "on the consumer thread (no cross-batch H2D pipelining). "
-                "Drop num_workers to use producer threads instead.",
+                "processes already decode in parallel (and H2D lives in "
+                "the placement plane, or on the consumer thread for the "
+                "sync device_put_fn arm). Drop num_workers to use "
+                "producer threads instead.",
                 stacklevel=2,
             )
         if self.workers is not None and (

@@ -1,0 +1,318 @@
+"""Placement plane: host batches → global device arrays, H2D off the step.
+
+Before r7 every loader ended the same way: the *consumer thread* called a
+private ``device_put_fn`` closure on each host batch, so the train step sat
+behind the H2D transfer it was about to consume — BENCH_AB_r05 measured
+~97% ``train_loader_stall_pct`` across all four 1-core arms, and a chunk of
+that stall was transfer, not decode. This module is the one shared exit
+from host memory (the alpa ``DataLoader`` pattern in SNIPPETS.md: per-device
+shards + ``prefetch_size`` device buffers):
+
+* :class:`PlacementPlane` — slices each host batch per **local device**
+  along the mesh's data axis, dispatches one async ``device_put`` per
+  device, and assembles the logical *global* array with
+  ``make_array_from_single_device_arrays`` (both primitives imported from
+  ``parallel/_compat.py``; LDT801 rejects direct ``jax.device_put`` on hot
+  paths so this funnel stays the only one).
+* :meth:`PlacementPlane.iter_placed` — a dedicated **placement thread**
+  pulls decoded host batches from the upstream pipeline, places them, and
+  keeps a depth-configurable (default 2) ring of device-resident batches
+  ahead of the consumer, so ``next(loader)`` returns an already-transferred
+  array and step N's compute overlaps batch N+1's DMA.
+* :class:`PlacedLoader` — the thin wrapper ``trainer._build_loader`` puts
+  around all five pipelines (``DataPipeline``, ``MapStylePipeline``,
+  ``FolderDataPipeline``, ``RemoteLoader``, ``FleetLoader``): they now
+  yield HOST batches and this plane owns placement, instead of five
+  private ``device_put_fn`` closures owning it five times.
+
+Buffer-plane contract: the placement thread releases each host batch's
+:class:`~.buffers.BufferPool` leases immediately after the per-device
+transfers are dispatched — *transfer-dispatch time, not consumer pickup*.
+That is safe (and is effectively release-on-transfer-complete) because the
+pool's refcount sweep only recycles a page once jax has dropped its own
+reference to the host buffer, which happens when the async copy finishes;
+until then the page parks on the pending list. Net effect: pages recycle
+one-or-more batches earlier than the old after-yield release, and an
+abandoned iterator can strand at most the ring's contents, which the
+teardown drain releases.
+
+Telemetry: ``trainer_h2d_ms`` histogram (per-batch dispatch+assembly time —
+the H2D share the old accounting folded into ``trainer_loader_ms``),
+``placement_buffer_depth`` gauge (device-resident batches ready in the
+ring), and a ``placement_*`` :class:`~..utils.metrics.ServiceCounters`
+window (``placement_h2d_s``) that ``StepTimer.attach_counters`` merges into
+per-step progress lines as ``h2d_pct``.
+
+Thread & queue policy (LDT201/LDT202): the placement thread is daemon, the
+ring queue is bounded at ``depth``, and teardown is drain-then-join — the
+same discipline as ``data/pipeline.py``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Iterator, Optional
+
+import numpy as np
+
+import jax
+
+from ..obs.registry import MetricsRegistry, default_registry
+from ..obs.spans import span
+from ..parallel._compat import (
+    device_put,
+    make_array_from_process_local_data,
+    make_array_from_single_device_arrays,
+)
+from ..utils.metrics import ServiceCounters
+
+__all__ = ["PlacementPlane", "PlacedLoader"]
+
+_SENTINEL = object()
+
+
+class PlacementPlane:
+    """Mesh-native batch placement with double-buffered async H2D.
+
+    Parameters
+    ----------
+    mesh: the device mesh (``parallel.mesh.get_mesh``).
+    data_axis / seq_axis: batch layout axes, as ``make_global_batch`` takes
+        them (rank-2 token arrays additionally shard over ``seq_axis``).
+    depth: ring size — device-resident batches kept ahead of the consumer.
+        2 double-buffers (one being consumed, one transferred); more only
+        pins extra HBM without more overlap unless step times are bimodal.
+    buffer_pool: the :class:`~.buffers.BufferPool` the decode plane leased
+        its output pages from; leases release at transfer dispatch.
+    """
+
+    def __init__(
+        self,
+        mesh,
+        *,
+        data_axis: str = "data",
+        seq_axis: Optional[str] = None,
+        depth: int = 2,
+        buffer_pool=None,
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.seq_axis = seq_axis
+        self.depth = max(1, depth)
+        self.buffer_pool = buffer_pool
+        self.registry = registry if registry is not None else default_registry()
+        self.counters = ServiceCounters(
+            prefix="placement", registry=self.registry
+        )
+        self._h2d_hist = self.registry.histogram("trainer_h2d_ms")
+        # (global_shape, local_shape, sharding) → per-device local slice
+        # plan, or None when the local window is not expressible as slices
+        # of the local array (fall back to the process-local assembly).
+        self._plans: dict = {}
+        # ndim → (NamedSharding, process_count): built once per rank, not
+        # per leaf per batch — this runs on the hot placement thread.
+        self._shardings: dict = {}
+
+    # -- single-batch placement --------------------------------------------
+
+    def _sharding_for(self, ndim: int):
+        cached = self._shardings.get(ndim)
+        if cached is not None:
+            return cached
+        from jax.sharding import NamedSharding
+
+        from ..parallel.sharding import batch_partition_spec
+
+        spec = batch_partition_spec(
+            ndim, data_axis=self.data_axis, seq_axis=self.seq_axis
+        )
+        cached = NamedSharding(self.mesh, spec), jax.process_count()
+        self._shardings[ndim] = cached
+        return cached
+
+    def _slice_plan(self, gshape, lshape, sharding):
+        """``[(device, local_index_tuple), …]`` mapping each addressable
+        device to the slice of THIS process's host array it receives;
+        ``None`` when the global indices don't line up with a contiguous
+        local window (exotic process→mesh layouts) — callers then fall back
+        to ``jax.make_array_from_process_local_data``."""
+        key = (tuple(gshape), tuple(lshape), sharding)
+        if key in self._plans:
+            return self._plans[key]
+        plan = []
+        try:
+            imap = sharding.addressable_devices_indices_map(tuple(gshape))
+            if not gshape:  # rank-0 leaf: replicated everywhere
+                plan = [(d, ()) for d in imap]
+            else:
+                starts = [
+                    (idx[0].start or 0) if idx else 0
+                    for idx in imap.values()
+                ]
+                offset = min(starts) if starts else 0
+                for d, idx in imap.items():
+                    idx = tuple(idx)
+                    local = []
+                    for dim, (sl, gdim, ldim) in enumerate(
+                        zip(idx, gshape, lshape)
+                    ):
+                        start = sl.start or 0
+                        stop = sl.stop if sl.stop is not None else gdim
+                        if dim == 0:
+                            # The data axis spans processes: rebase the
+                            # global row window onto this process's block.
+                            start -= offset
+                            stop -= offset
+                        if start < 0 or stop > ldim or stop <= start:
+                            raise ValueError("non-local window")
+                        local.append(slice(start, stop))
+                    plan.append((d, tuple(local)))
+        except (ValueError, TypeError, AttributeError):
+            plan = None
+        self._plans[key] = plan
+        return plan
+
+    def _place_leaf(self, x):
+        x = np.asarray(x)
+        sharding, nproc = self._sharding_for(x.ndim)
+        gshape = (
+            (x.shape[0] * nproc,) + x.shape[1:]
+            if nproc > 1 and x.ndim >= 1
+            else x.shape
+        )
+        plan = self._slice_plan(gshape, x.shape, sharding)
+        if plan is None:
+            # Non-contiguous local window: the generic (slower) assembly
+            # still yields the identical global array.
+            if nproc == 1:
+                return device_put(x, sharding)
+            return make_array_from_process_local_data(sharding, x)
+        # ONE device_put over the shard/device lists (jax fans it out):
+        # eight separate calls cost ~8x the python dispatch on this thread.
+        shards = device_put(
+            [x[idx] for _, idx in plan], [d for d, _ in plan]
+        )
+        return make_array_from_single_device_arrays(
+            tuple(gshape), sharding, shards
+        )
+
+    def place_batch(self, host_batch):
+        """One host batch (pytree of numpy arrays) → global ``jax.Array``
+        pytree, per-device transfers dispatched asynchronously. Bit-identical
+        to ``make_global_batch(host_batch, mesh)`` — pinned by
+        ``tests/test_placement.py``."""
+        return jax.tree_util.tree_map(self._place_leaf, host_batch)
+
+    def _release(self, host_batch) -> None:
+        if self.buffer_pool is not None:
+            self.buffer_pool.release_batch(host_batch)
+
+    # -- the ring ----------------------------------------------------------
+
+    def iter_placed(self, inner) -> Iterator:
+        """Iterate ``inner``'s host batches as already-placed global arrays.
+
+        A dedicated placement thread pulls from ``inner``, places each batch
+        (async H2D dispatch), releases the host pages' pool leases, and
+        fills a bounded ring of ``depth`` device-resident batches; the
+        consumer pops ready arrays. Teardown is drain-then-join, and the
+        inner iterator is closed from the placement thread so upstream
+        producer threads observe their stop flags.
+        """
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def produce() -> None:
+            try:
+                it = iter(inner)
+                try:
+                    for seq, host in enumerate(it):
+                        if stop.is_set():
+                            return
+                        t0 = time.monotonic_ns()
+                        with span("placement.h2d", batch_seq=seq):
+                            dev = self.place_batch(host)
+                        dt_ms = (time.monotonic_ns() - t0) / 1e6
+                        self._h2d_hist.observe(dt_ms)
+                        self.counters.add("h2d_s", dt_ms / 1e3)
+                        self.counters.add("batches_placed")
+                        # Transfers dispatched: leases go back NOW (the
+                        # pool's refcount sweep defers actual recycling to
+                        # transfer-complete), not at consumer pickup.
+                        self._release(host)
+                        q.put(dev)
+                        self._set_depth(q.qsize())
+                    q.put(_SENTINEL)
+                finally:
+                    close = getattr(it, "close", None)
+                    if close is not None:
+                        close()
+            except BaseException as exc:  # surface to the consumer
+                q.put(exc)
+
+        thread = threading.Thread(
+            target=produce, daemon=True, name="ldt-placement"
+        )
+        thread.start()
+        try:
+            while True:
+                item = q.get()
+                self._set_depth(q.qsize())
+                if item is _SENTINEL:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            # Drain so a blocked put() can observe the stop flag. Drained
+            # items are device batches (host leases already released at
+            # dispatch) — dropping them frees HBM via ordinary GC.
+            while thread.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    thread.join(timeout=0.1)
+            self._set_depth(0)
+
+    def _set_depth(self, n: int) -> None:
+        # One write: the ServiceCounters gauge lands in the registry under
+        # placement_buffer_depth (the /metrics series) AND in the
+        # per-window merge StepTimer reads — no second direct-gauge copy.
+        self.counters.gauge("buffer_depth", n)
+
+    def wrap(self, inner) -> "PlacedLoader":
+        return PlacedLoader(self, inner)
+
+
+class PlacedLoader:
+    """A pipeline that yields host batches, placed through a
+    :class:`PlacementPlane`. Delegates ``len``/``set_epoch``; exposes the
+    inner loader's ``counters`` (svc_*/fleet_* windows) unchanged plus the
+    plane's ``placement_counters`` for ``StepTimer.attach_counters``."""
+
+    def __init__(self, plane: PlacementPlane, inner):
+        self.plane = plane
+        self.inner = inner
+
+    def __len__(self) -> int:
+        return len(self.inner)
+
+    def set_epoch(self, epoch: int) -> None:
+        set_epoch = getattr(self.inner, "set_epoch", None)
+        if set_epoch is not None:
+            set_epoch(epoch)
+
+    @property
+    def counters(self):
+        return getattr(self.inner, "counters", None)
+
+    @property
+    def placement_counters(self) -> ServiceCounters:
+        return self.plane.counters
+
+    def __iter__(self) -> Iterator:
+        return self.plane.iter_placed(self.inner)

@@ -20,6 +20,8 @@ When to use which decode parallelism:
 * ``num_workers=0`` (default): producer thread + native C++ decoder
   (:mod:`..native`) — the decode pool releases the GIL, so threads already
   scale across cores with zero IPC cost. Best when the native path is built.
+  (Since r7 neither choice affects H2D: placement runs on the plane's own
+  thread downstream of the pool, :mod:`.placement`.)
 * ``num_workers>0``: process workers — true parallelism for *Python-bound*
   decode hooks (custom ``to_tensor_fn``/``collate_fn`` plugins that hold the
   GIL). With the default ``transport="shm"`` the decoded tensors cross the

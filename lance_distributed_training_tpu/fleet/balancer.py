@@ -2,12 +2,15 @@
 
 Drop-in replacement for :class:`~..service.client.RemoteLoader` that takes a
 *coordinator* address instead of a server address: it resolves the live
-membership, opens one protocol-v3 stream per member with
-``stripe_index/stripe_count`` HELLOs (member ``i`` of ``n`` serves exactly
-the plan steps ``s % n == i``), and merges the streams back into plan order
-— so the yielded batch sequence is **bit-identical** to a single
-``RemoteLoader`` against one server, while decode bandwidth scales with the
-fleet.
+membership, takes THIS training process's deterministic slice of it
+(:func:`members_for_process` — fleet stripes map onto
+``jax.process_index()``, so each host fetches exactly its shard of the
+global batch and no server ships redundant bytes to two hosts), opens one
+protocol-v3 stream per assigned member with ``stripe_index/stripe_count``
+HELLOs (member ``i`` of ``n`` serves exactly the plan steps ``s % n == i``),
+and merges the streams back into plan order — so the yielded batch sequence
+is **bit-identical** to a single ``RemoteLoader`` against one server, while
+decode bandwidth scales with the fleet.
 
 Failover model (the reason this class exists): the merge loop owns a single
 global cursor — the first step not yet handed to the consumer. When any
@@ -44,10 +47,40 @@ from ..obs.registry import MetricsRegistry, default_registry
 from ..utils.metrics import ServiceCounters
 from ..service import protocol as P
 
-__all__ = ["FleetLoader"]
+__all__ = ["FleetLoader", "members_for_process"]
 
 _SENTINEL = object()
 _STRIPE_END = object()
+
+
+def members_for_process(members: list, process_index: int,
+                        process_count: int) -> list:
+    """Deterministic, disjoint member→training-process assignment.
+
+    Multi-host training used to have every jax process stripe over the
+    WHOLE fleet: with P hosts and N servers, each server decoded and
+    shipped P different shards' stripes — P× the connections and redundant
+    wire bytes per member. Instead, process ``p`` of ``P`` takes a
+    contiguous balanced slice of the ``server_id``-sorted member list, so
+    each host fetches exactly its shard of the global batch from its own
+    members and no server serves two hosts (when ``len(members) >= P``).
+
+    Properties (pinned by ``tests/test_placement.py``): deterministic in
+    the sorted member order; slices are disjoint and cover every member;
+    sizes differ by at most one. With fewer members than processes the
+    fleet cannot be partitioned — processes then share members round-robin
+    (correctness holds: each process still requests only its own shard's
+    plan in the HELLO, a shared member just serves two plans).
+    """
+    n = len(members)
+    if n == 0 or process_count <= 1:
+        return list(members)
+    if n < process_count:
+        return [members[process_index % n]]
+    base, extra = divmod(n, process_count)
+    start = process_index * base + min(process_index, extra)
+    stop = start + base + (1 if process_index < extra else 0)
+    return list(members[start:stop])
 
 
 class _StripeFailure(Exception):
@@ -340,11 +373,13 @@ class FleetLoader:
         self, stop: Optional[threading.Event] = None,
     ) -> list:
         """Membership with retry/backoff (an empty fleet keeps retrying —
-        members may still be booting). Returns the member list sorted by
-        ``server_id`` (the deterministic stripe order), with recently-failed
-        addresses excluded — unless exclusion would empty the list, in which
-        case the exclusions are dropped (a possibly-recovered server beats
-        certain starvation)."""
+        members may still be booting). Returns THIS process's slice of the
+        member list sorted by ``server_id`` (:func:`members_for_process` —
+        every training host stripes over its own disjoint members, so no
+        server ships redundant bytes to two hosts), with recently-failed
+        addresses excluded — unless exclusion would empty the slice, in
+        which case the exclusions are dropped (a possibly-recovered server
+        beats certain starvation)."""
         last: Optional[Exception] = None
         backoff = self.backoff_s
         for _ in range(self.resolve_retries):
@@ -364,16 +399,23 @@ class FleetLoader:
                     key=lambda m: str(m.get("server_id", "")),
                 )
                 self.counters.gauge("members", len(members))
+                # Slice BEFORE exclusion: the process→member mapping must
+                # stay stable across failover rounds (an exclusion on host
+                # A must not shift host B's stripes onto new servers).
+                mine = members_for_process(
+                    members, self.process_index, self.process_count
+                )
+                self.counters.gauge("members_assigned", len(mine))
                 now = time.monotonic()
                 self._excluded = {
                     a: t for a, t in self._excluded.items() if t > now
                 }
                 live = [
-                    m for m in members
+                    m for m in mine
                     if m.get("addr") not in self._excluded
                 ]
                 if not live:
-                    live = members  # all excluded: try everyone again
+                    live = mine  # all excluded: try everyone again
                 if live:
                     return live
                 last = ConnectionError("fleet has no registered members")

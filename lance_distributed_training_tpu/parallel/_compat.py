@@ -13,6 +13,15 @@ runs (the container pins one version; TPU pods often pin another):
   per-output replication check (``check_rep=False``) and make ``pcast``
   the identity — the program is unchanged, only the static type
   annotation differs.
+* the **placement primitives** ``device_put`` and
+  ``make_array_from_single_device_arrays`` are re-exported here so the
+  placement plane (``data/placement.py``) and ``parallel/mesh.py`` have one
+  door to the H2D surface: the signatures are stable on 0.4.37 but the
+  assembly entry point moved around earlier 0.4.x releases
+  (``jax.experimental.array`` era), and funnelling every caller through the
+  shim is what lets the LDT801 lint reject stray ``jax.device_put`` calls
+  on hot paths (a synchronous consumer-thread ``device_put`` is exactly the
+  stall the placement plane exists to remove).
 
 Import from here, never from jax directly, for any symbol listed in
 ``__all__``.
@@ -20,9 +29,64 @@ Import from here, never from jax directly, for any symbol listed in
 
 from __future__ import annotations
 
+import jax
 from jax import lax
 
-__all__ = ["shard_map", "pcast", "axis_size"]
+__all__ = [
+    "shard_map",
+    "pcast",
+    "axis_size",
+    "device_put",
+    "make_array_from_single_device_arrays",
+    "make_array_from_process_local_data",
+]
+
+# Placement primitives (see module docstring). Plain aliases on every jax
+# this container runs; the try/except keeps package import alive on the
+# early-0.4 releases where assembly lived under jax.experimental.array.
+device_put = jax.device_put
+
+try:
+    make_array_from_single_device_arrays = (
+        jax.make_array_from_single_device_arrays
+    )
+except AttributeError:  # pragma: no cover — pre-0.4.7 fallback
+    from jax.experimental.array import (  # type: ignore[no-redef]
+        make_array_from_single_device_arrays,
+    )
+
+try:
+    make_array_from_process_local_data = (
+        jax.make_array_from_process_local_data
+    )
+except AttributeError:  # pragma: no cover — pre-0.4.31: emulate via the
+    # per-device assembly (the process-local helper is itself sugar for it)
+    def make_array_from_process_local_data(sharding, local_data):
+        import numpy as np
+
+        x = np.asarray(local_data)
+        gshape = list(x.shape)
+        if gshape:
+            import jax as _jax
+
+            gshape[0] *= _jax.process_count()
+        imap = sharding.addressable_devices_indices_map(tuple(gshape))
+        starts = [(idx[0].start or 0) if idx else 0 for idx in imap.values()]
+        offset = min(starts) if starts else 0
+        shards = []
+        for d, idx in imap.items():
+            idx = tuple(idx)
+            if idx:
+                first = slice(
+                    (idx[0].start or 0) - offset,
+                    (idx[0].stop if idx[0].stop is not None
+                     else gshape[0]) - offset,
+                )
+                idx = (first,) + idx[1:]
+            shards.append(device_put(x[idx], d))
+        return make_array_from_single_device_arrays(
+            tuple(gshape), sharding, shards
+        )
 
 try:  # jax >= 0.6: top-level export
     from jax import shard_map as _shard_map_new

@@ -144,7 +144,15 @@ def make_global_batch(
     With ``seq_axis`` set, rank-2 leaves (token arrays ``[B, S]``) are
     additionally split along the sequence axis — context parallelism's input
     layout (each device holds a [batch-shard × sequence-block] tile).
+
+    This is the *synchronous* placement primitive (and the bit-parity
+    reference the placement plane's tests pin against); the trainer's
+    default path is :class:`~..data.placement.PlacementPlane`, which
+    dispatches the same transfers from a background thread so they overlap
+    the step. ``device_put`` routes through ``_compat`` — the one H2D door
+    LDT801 allows outside ``data/placement.py``.
     """
+    from ._compat import device_put, make_array_from_process_local_data
     from .sharding import batch_partition_spec
 
     def _put(x):
@@ -153,8 +161,8 @@ def make_global_batch(
                                     seq_axis=seq_axis)
         sharding = NamedSharding(mesh, spec)
         if jax.process_count() == 1:
-            return jax.device_put(x, sharding)
-        return jax.make_array_from_process_local_data(sharding, x)
+            return device_put(x, sharding)
+        return make_array_from_process_local_data(sharding, x)
 
     return jax.tree_util.tree_map(_put, pytree)
 

@@ -160,7 +160,9 @@ def _fsdp_spec(shape: Sequence[int], mesh: Mesh, axis: str,
 
 def partition_specs(tree, rules: Sequence[Tuple[str, P]], mesh: Mesh, *,
                     fsdp_axis: Optional[str] = None,
-                    fsdp_min_size: int = 16384):
+                    fsdp_min_size: int = 16384,
+                    zero_axis: Optional[str] = None,
+                    zero_paths: Sequence[str] = ("opt_state",)):
     """Pytree (arrays or ShapeDtypeStructs) → pytree of PartitionSpec.
 
     Every leaf's path is matched against ``rules`` (``re.search`` on the
@@ -177,6 +179,18 @@ def partition_specs(tree, rules: Sequence[Tuple[str, P]], mesh: Mesh, *,
     (biases, layer norms, batch-norm statistics, step counters) stay
     replicated — sharding them saves nothing and costs latency-bound
     collectives.
+
+    ``zero_axis`` is the ZeRO-1 slice of that trade (PAPERS.md, arXiv
+    2004.13336): only leaves whose path starts with one of ``zero_paths``
+    (the optimizer state) shard their largest divisible dimension over the
+    axis; params stay replicated (or rule-sharded). Under those annotations
+    the SPMD partitioner turns the gradient all-reduce into a
+    reduce-scatter feeding each replica's optimizer-state shard, applies
+    the update shard-locally, and all-gathers only the updated params —
+    optimizer memory scales 1/N with the data axis while the forward/
+    backward keep full replicas (no per-layer gathers, unlike FSDP).
+    Composable with rule-sharded params: a rule-matched opt-state leaf
+    keeps its rule spec (it already co-locates with its param shard).
     """
     compiled = [(re.compile(pat), spec) for pat, spec in rules]
 
@@ -188,10 +202,17 @@ def partition_specs(tree, rules: Sequence[Tuple[str, P]], mesh: Mesh, *,
             if pat.search(name):
                 spec = _fit_spec(s, shape, mesh)
                 break
-        if fsdp_axis is not None and not any(a is not None for a in spec):
-            fs = _fsdp_spec(shape, mesh, fsdp_axis, fsdp_min_size)
-            if fs is not None:
-                return fs
+        if not any(a is not None for a in spec):
+            if fsdp_axis is not None:
+                fs = _fsdp_spec(shape, mesh, fsdp_axis, fsdp_min_size)
+                if fs is not None:
+                    return fs
+            if zero_axis is not None and any(
+                name == p or name.startswith(p + "/") for p in zero_paths
+            ):
+                zs = _fsdp_spec(shape, mesh, zero_axis, fsdp_min_size)
+                if zs is not None:
+                    return zs
         return spec
 
     return jax.tree_util.tree_map_with_path(assign, tree)
@@ -199,16 +220,18 @@ def partition_specs(tree, rules: Sequence[Tuple[str, P]], mesh: Mesh, *,
 
 def state_shardings(abstract_state, mesh: Mesh, rules: Sequence[Tuple[str, P]],
                     *, fsdp_axis: Optional[str] = None,
-                    fsdp_min_size: int = 16384):
+                    fsdp_min_size: int = 16384,
+                    zero_axis: Optional[str] = None):
     """NamedSharding tree for a whole TrainState.
 
     Works on ``jax.eval_shape`` output; because the optimizer's momentum/trace
     mirrors the param tree, the same path-tail rules shard it identically —
     params and their optimizer state are always co-located. With ``fsdp_axis``
-    set, both are fully sharded over that axis (see :func:`partition_specs`).
+    set, both are fully sharded over that axis; with ``zero_axis`` set, only
+    the ``opt_state`` subtree is (ZeRO-1 — see :func:`partition_specs`).
     """
     specs = partition_specs(abstract_state, rules, mesh, fsdp_axis=fsdp_axis,
-                            fsdp_min_size=fsdp_min_size)
+                            fsdp_min_size=fsdp_min_size, zero_axis=zero_axis)
     return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs)
 
 

@@ -4,9 +4,14 @@ Drop-in replacement for :class:`~..data.pipeline.DataPipeline` on the TPU
 host: iterating yields the *identical* sequence of batches the in-process
 pipeline would produce for the same (dataset, sampler, batch, shard, seed,
 epoch) — the server builds the same deterministic ``Plan`` — but decode ran
-on the service host, so the trainer's cores stay free for ``device_put``
-dispatch. With ``device_put_fn`` bound to ``make_global_batch(mesh)`` the
-trainer sees the exact same ``jax.Array`` contract as every other loader.
+on the service host, so the trainer's cores stay free. Mesh-native by
+construction: the HELLO carries ``jax.process_index()``/``process_count``
+as the shard, so each training host streams exactly its slice of the
+global batch — no redundant bytes over the wire — and the trainer wraps
+this loader in the placement plane (:mod:`~..data.placement`), which
+assembles the NamedSharding global array with double-buffered async H2D.
+``device_put_fn`` remains the synchronous escape hatch
+(``--no_global_batch``).
 
 Robustness: a background receiver thread prefetches frames into the same
 bounded-queue discipline ``DataPipeline`` uses; every received step is ACKed,

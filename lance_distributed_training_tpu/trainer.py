@@ -144,8 +144,19 @@ class TrainConfig:
     seq_len: int = 128  # masked_lm / contrastive text length
     vocab_size: Optional[int] = None  # None = the model's own default
     prefetch: int = 2
-    producer_threads: int = 4  # decode-producer threads; also pipelines the
-    # per-batch H2D copy (expensive on tunneled TPU clients) across threads
+    producer_threads: int = 4  # decode-producer threads; with the placement
+    # plane off (--no_global_batch) these also pipeline the per-batch H2D
+    # copy (expensive on tunneled TPU clients) across threads
+    global_batch: bool = True  # route every loader through the placement
+    # plane (data/placement.py): a dedicated thread slices each host batch
+    # per local device, dispatches async H2D, and keeps placement_depth
+    # device-resident global batches ahead of the step — next(loader)
+    # returns an already-transferred array. False = the pre-r7 control arm:
+    # a synchronous make_global_batch closure on the consumer thread
+    # (bit-identical batches, H2D counted inside loader stall).
+    placement_depth: int = 2  # device-resident batches the placement ring
+    # keeps ahead of the step; 2 double-buffers (one consumed, one in
+    # flight), more pins extra HBM for little added overlap
     data_echo: int = 1  # >1: run N train steps per host batch ("data
     # echoing", Choi et al. 2019) — each echo re-draws the on-device
     # augmentation / MLM masking rng, so echoes are not exact repeats. When
@@ -195,6 +206,12 @@ class TrainConfig:
     pp_microbatches: int = 4  # microbatches per pipeline round
     fsdp: bool = False  # ZeRO-3-style: fully shard params + optimizer state
     # over the 'data' axis; XLA inserts the per-layer all-gathers
+    zero_opt: bool = False  # ZeRO-1-style: shard ONLY the optimizer state
+    # over the 'data' axis (params stay replicated) — the SPMD partitioner
+    # reduce-scatters gradients into each replica's opt-state shard and
+    # all-gathers just the updated params, so optimizer memory scales 1/N
+    # with the mesh at no per-layer forward/backward gathers. Mutually
+    # exclusive with fsdp (which already shards the optimizer state).
     # -- aux subsystems the reference lacks (SURVEY.md §5) --
     checkpoint_dir: Optional[str] = None  # orbax save/restore root
     checkpoint_every: int = 1  # save every N epochs
@@ -338,7 +355,8 @@ def create_train_state(rng: jax.Array, task: Task, config: TrainConfig,
 
 def create_sharded_train_state(
     rng: jax.Array, task: Task, config: TrainConfig, mesh, rules=(),
-    *, fsdp_axis: Optional[str] = None, total_steps: Optional[int] = None,
+    *, fsdp_axis: Optional[str] = None, zero_axis: Optional[str] = None,
+    total_steps: Optional[int] = None,
 ):
     """Initialize the TrainState *directly sharded* over the mesh.
 
@@ -347,7 +365,9 @@ def create_sharded_train_state(
     round-trip, no full replica anywhere (how a model larger than one chip's
     HBM gets initialized). With ``fsdp_axis``, rule-unmatched leaves (params
     AND their optimizer state) fully shard over that axis instead of
-    replicating. Returns ``(state, sharding_pytree)``.
+    replicating; with ``zero_axis``, only the optimizer state does (ZeRO-1 —
+    each device initializes just its momentum/moment shard). Returns
+    ``(state, sharding_pytree)``.
     """
     from .parallel.sharding import state_shardings
 
@@ -366,7 +386,8 @@ def create_sharded_train_state(
         )
 
     abstract = jax.eval_shape(_create, rng)
-    shardings = state_shardings(abstract, mesh, rules, fsdp_axis=fsdp_axis)
+    shardings = state_shardings(abstract, mesh, rules, fsdp_axis=fsdp_axis,
+                                zero_axis=zero_axis)
     return jax.jit(_create, out_shardings=shardings)(rng), shardings
 
 
@@ -552,6 +573,24 @@ def _make_worker_pool(config: TrainConfig, dataset):
     )
 
 
+def _make_placement(config: TrainConfig, mesh):
+    """The run's :class:`~.data.placement.PlacementPlane` — ``None`` when
+    the synchronous control arm (``--no_global_batch``) is selected. One
+    plane per loader build; the plane shares the process BufferPool with
+    the decode side so leases released at transfer dispatch warm the next
+    decode."""
+    if not config.global_batch or mesh is None:
+        return None
+    from .data.placement import PlacementPlane
+
+    return PlacementPlane(
+        mesh,
+        seq_axis="seq" if config.seq_parallelism > 1 else None,
+        depth=config.placement_depth,
+        buffer_pool=_loader_buffer_pool(config),
+    )
+
+
 def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
                   workers=None, index_pool=None):
     process_index, process_count = process_topology()
@@ -562,11 +601,21 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             f"{process_count} processes"
         )
     decode = _decoder_for(config)
-    put = partial(
-        make_global_batch,
-        mesh=mesh,
-        seq_axis="seq" if config.seq_parallelism > 1 else None,
-    )
+    # Placement: default is the async plane (host batches out of the
+    # pipelines, one placement thread owning H2D); the control arm keeps
+    # the legacy synchronous closure on the consumer thread.
+    plane = _make_placement(config, mesh)
+    if plane is not None:
+        put = None
+    else:
+        put = partial(
+            make_global_batch,
+            mesh=mesh,
+            seq_axis="seq" if config.seq_parallelism > 1 else None,
+        )
+
+    def _placed(loader):
+        return plane.wrap(loader) if plane is not None else loader
     if config.data_service_addr or config.coordinator_addr:
         # Disaggregated input plane: decode runs in remote DataService
         # processes; this process only streams host batches and dispatches
@@ -612,7 +661,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
                 "empty plan from data service: dataset smaller than one "
                 f"global batch ({config.batch_size})"
             )
-        return loader
+        return _placed(loader)
     if config.filter and config.data_format != "columnar":
         raise ValueError("filter= needs the columnar store (data_format="
                          "'columnar'); folder trees have no row predicates")
@@ -651,7 +700,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
                 f"num_classes={config.num_classes}; out-of-range labels "
                 "would be silently clamped by the XLA gather"
             )
-        return loader
+        return _placed(loader)
     columns = getattr(decode, "required_columns", None)
     if config.filter and config.loader_style != "map":
         raise ValueError(
@@ -708,7 +757,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             "empty plan: dataset smaller than one global batch "
             f"({dataset.count_rows()} rows, global batch {config.batch_size})"
         )
-    return loader
+    return _placed(loader)
 
 
 def _split_val_pool(config: TrainConfig, dataset, index_pool):
@@ -761,11 +810,15 @@ def _build_eval_loader(config: TrainConfig, dataset, mesh, index_pool=None):
 
     process_index, process_count = process_topology()
     decode = _decoder_for(config)
-    put = partial(
-        make_global_batch,
-        mesh=mesh,
-        seq_axis="seq" if config.seq_parallelism > 1 else None,
-    )
+    plane = _make_placement(config, mesh)
+    if plane is not None:
+        put = None
+    else:
+        put = partial(
+            make_global_batch,
+            mesh=mesh,
+            seq_axis="seq" if config.seq_parallelism > 1 else None,
+        )
     if config.data_format == "folder":
         from .data.authoring import _folder_samples
         from .data.folder import read_sample_batch
@@ -785,7 +838,7 @@ def _build_eval_loader(config: TrainConfig, dataset, mesh, index_pool=None):
         total = dataset.count_rows()
         if config.filter and index_pool is None:
             index_pool = dataset.filter_indices(config.filter)
-    return make_eval_pipeline(
+    loader = make_eval_pipeline(
         read_fn,
         total,
         config.batch_size,
@@ -798,6 +851,7 @@ def _build_eval_loader(config: TrainConfig, dataset, mesh, index_pool=None):
         index_pool=index_pool,
         buffer_pool=_loader_buffer_pool(config),
     )
+    return plane.wrap(loader) if plane is not None else loader
 
 
 def _per_device_batch_bytes(batch) -> int:
@@ -890,6 +944,15 @@ def train(config: TrainConfig) -> dict:
         raise ValueError(
             "data_service_addr and coordinator_addr are mutually exclusive "
             "(one names a single server, the other a fleet's coordinator)"
+        )
+    if config.fsdp and config.zero_opt:
+        raise ValueError(
+            "fsdp and zero_opt are mutually exclusive: fsdp (ZeRO-3) "
+            "already shards the optimizer state along with the params"
+        )
+    if config.placement_depth < 1:
+        raise ValueError(
+            f"placement_depth must be >= 1, got {config.placement_depth}"
         )
     if config.data_service_addr or config.coordinator_addr:
         remote_knob = (
@@ -1011,7 +1074,9 @@ def train(config: TrainConfig) -> dict:
         )
     state, state_sharding = create_sharded_train_state(
         init_rng, task, config, mesh, rules,
-        fsdp_axis="data" if config.fsdp else None, total_steps=total_steps,
+        fsdp_axis="data" if config.fsdp else None,
+        zero_axis="data" if config.zero_opt else None,
+        total_steps=total_steps,
     )
     if config.pretrained:
         # Transfer learning (the reference's actual training task): replace
@@ -1175,9 +1240,13 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             it = iter(loader)
         # RemoteLoader exposes ServiceCounters: merge its stall/queue window
         # into per-step progress lines so loader-stall% stays attributable
-        # (client receive stall vs server queue vs device). None detaches.
+        # (client receive stall vs server queue vs H2D vs device); a
+        # PlacedLoader additionally exposes the placement plane's counters
+        # (placement_h2d_s → the h2d_pct progress field). None detaches.
         timer.attach_counters(
-            getattr(loader, "counters", None) if loader is not None else None
+            getattr(loader, "counters", None) if loader is not None else None,
+            getattr(loader, "placement_counters", None)
+            if loader is not None else None,
         )
         filling = cache_ok and not replay
         timer.reset()
@@ -1286,6 +1355,15 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                             100.0 * w["loader_s"] / wt if wt else 0.0
                         ),
                     }
+                    if "placement_h2d_s" in w:
+                        # H2D dispatch time this window (runs on the
+                        # placement thread, overlapping the step) as a
+                        # share of the same loader+step denominator — the
+                        # transfer cost the pre-r7 accounting folded
+                        # invisibly into loader_stall_pct.
+                        entry["h2d_pct"] = (
+                            100.0 * w["placement_h2d_s"] / wt if wt else 0.0
+                        )
                     # Data-service windows (RemoteLoader counters attached
                     # to the timer): svc_client_stall_s, svc_reconnects, …
                     entry.update({

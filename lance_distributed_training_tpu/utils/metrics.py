@@ -236,7 +236,7 @@ class StepTimer:
 
     def __init__(self, registry: Optional[MetricsRegistry] = None):
         self.registry = registry if registry is not None else default_registry()
-        self._counters: Optional[ServiceCounters] = None
+        self._counters: list[ServiceCounters] = []
         # Per-instance histograms (percentiles() = this timer's lifetime,
         # i.e. one train() run); the registry aggregate (the scrape view)
         # is resolved by name at each *_stop.
@@ -260,12 +260,15 @@ class StepTimer:
         # window's wall width instead.
         self._w_wall = time.perf_counter()
 
-    def attach_counters(self, counters: Optional[ServiceCounters]) -> None:
-        """Merge a :class:`ServiceCounters` window into every ``window()``:
-        when the loader is a ``RemoteLoader``, per-step progress lines then
-        carry svc_* stall/queue fields next to loader_s, so a stall spike is
-        attributable (server queue empty vs client receive vs device)."""
-        self._counters = counters
+    def attach_counters(self, *counters: Optional[ServiceCounters]) -> None:
+        """Merge one or more :class:`ServiceCounters` windows into every
+        ``window()``: when the loader is a ``RemoteLoader`` the per-step
+        progress lines carry svc_* stall/queue fields next to loader_s, and
+        a :class:`~..data.placement.PlacementPlane`'s ``placement_*``
+        counters ride alongside — so a stall spike is attributable (server
+        queue empty vs client receive vs H2D vs device). ``None`` entries
+        are skipped; calling with no (or all-``None``) arguments detaches."""
+        self._counters = [c for c in counters if c is not None]
 
     def window(self, batch_size: Optional[int] = None) -> dict:
         """Deltas since the previous ``window()`` call (or ``reset``) — the
@@ -298,8 +301,8 @@ class StepTimer:
         self._w_step = self.step_s
         self._w_steps = self.steps
         self._w_wall = now
-        if self._counters is not None:
-            out.update(self._counters.window())
+        for counters in self._counters:
+            out.update(counters.window())
         return out
 
     def loader_start(self) -> None:

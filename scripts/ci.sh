@@ -17,7 +17,12 @@
 # stream must complete bit-identical with fleet_failovers_total >= 1, the
 # coordinator must expire the corpse, the survivor must drain on SIGTERM
 # with exit 0, and /dev/shm must end clean.
-# Stage 5 — the tier-1 verify command from ROADMAP.md, verbatim.
+# Stage 5 — placement smoke (scripts/placement_smoke.py): 8 XLA-forced CPU
+# devices, a 2-simulated-process shard parity check, global batch
+# shape/sharding through the async placement plane (bit-identical to the
+# sync control arm), and trainer_h2d_ms / placement_buffer_depth on
+# /metrics.
+# Stage 6 — the tier-1 verify command from ROADMAP.md, verbatim.
 set -e
 cd "$(dirname "$0")/.."
 
@@ -93,6 +98,12 @@ echo "== fleet smoke (coordinator + 2 servers, SIGKILL mid-stream) =="
 # the SIGKILL is a genuine process death and the SIGTERM drain is the real
 # docker-stop path, not an in-process simulation.
 timeout -k 10 420 env JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/fleet_smoke.py
+
+echo "== placement smoke (mesh-native global batches + H2D telemetry) =="
+# 2-simulated-process shard parity on 8 forced CPU devices (the
+# _bench_init.force_cpu XLA_FLAGS fallback), placed-vs-sync bit parity,
+# and the trainer_h2d_ms series scraped from a live /metrics.
+timeout -k 10 300 env PYTHONPATH=. python scripts/placement_smoke.py
 
 echo "== tier-1 tests =="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -685,6 +685,96 @@ def test_ldt701_repo_hot_paths_only_have_baselined_findings():
     assert new == [], [f.location() for f in new]
 
 
+# -- LDT801 placement hygiene ------------------------------------------------
+
+
+def test_ldt801_flags_direct_h2d_calls_on_hot_paths(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {"data/loader.py": """\
+            import jax
+            from jax import device_put
+
+            def place(batch, sharding, shards):
+                a = jax.device_put(batch, sharding)
+                b = device_put(batch, sharding)
+                c = jax.make_array_from_single_device_arrays(
+                    (8,), sharding, shards
+                )
+                d = jax.make_array_from_process_local_data(sharding, batch)
+                return a, b, c, d
+        """},
+        hot_paths=["data/*"],
+    )
+    ldt801 = [f for f in findings if f.rule == "LDT801"]
+    assert len(ldt801) == 4, [f.message for f in findings]
+    assert "placement plane" in ldt801[0].message
+
+
+def test_ldt801_accepts_compat_routed_calls(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {"data/loader.py": """\
+            from parallel._compat import (
+                device_put,
+                make_array_from_single_device_arrays,
+            )
+
+            def place(batch, sharding, shards):
+                a = device_put(batch, sharding)
+                b = make_array_from_single_device_arrays(
+                    (8,), sharding, shards
+                )
+                return a, b
+        """},
+        hot_paths=["data/*"],
+    )
+    assert [f for f in findings if f.rule == "LDT801"] == []
+
+
+def test_ldt801_exempts_the_placement_plane_itself(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {"data/placement.py": """\
+            import jax
+
+            def place(batch, sharding):
+                return jax.device_put(batch, sharding)
+        """},
+        hot_paths=["data/*"],
+    )
+    assert [f for f in findings if f.rule == "LDT801"] == []
+
+
+def test_ldt801_ignores_cold_modules(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {"tools/restore.py": """\
+            import jax
+
+            def commit(tree, shardings):
+                return jax.device_put(tree, shardings)
+        """},
+        hot_paths=["data/*"],
+    )
+    assert [f for f in findings if f.rule == "LDT801"] == []
+
+
+def test_ldt801_repo_hot_paths_are_clean():
+    """The real tree: the shipped hot-path modules route every H2D call
+    through data/placement.py or parallel/_compat.py — zero LDT801
+    findings, no baseline entries needed."""
+    import os
+
+    from lance_distributed_training_tpu.analysis.config import load_config
+    from lance_distributed_training_tpu.analysis.core import analyze_project
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    config = load_config(root)
+    findings, _, _ = analyze_project(root, config)
+    assert [f.location() for f in findings if f.rule == "LDT801"] == []
+
+
 # -- suppressions ------------------------------------------------------------
 
 

@@ -1,0 +1,302 @@
+"""Placement-plane tests: bit-parity, lease lifecycle, stripe mapping, ZeRO.
+
+Pins the r7 acceptance contracts:
+
+* the async placement plane yields global arrays **bit-identical** to the
+  synchronous ``make_global_batch`` path (same sharding, same bytes);
+* fleet stripe→training-process assignment is deterministic, disjoint, and
+  covering across process counts;
+* ``BufferPool`` leases release at transfer dispatch (effectively
+  transfer-complete, via the refcount sweep) — an abandoned iterator
+  mid-ring strands nothing;
+* ZeRO-1 (``zero_opt``) shards only the optimizer state over the data axis
+  and trains bit-compatibly with the replicated path.
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from lance_distributed_training_tpu.data import (
+    ImageClassificationDecoder,
+    PlacementPlane,
+    make_train_pipeline,
+)
+from lance_distributed_training_tpu.data.buffers import BufferPool
+from lance_distributed_training_tpu.fleet.balancer import members_for_process
+from lance_distributed_training_tpu.obs.registry import MetricsRegistry
+from lance_distributed_training_tpu.parallel import get_mesh, make_global_batch
+
+
+def _batch(rng, rows=16, px=8):
+    return {
+        "image": rng.integers(0, 255, (rows, px, px, 3)).astype(np.uint8),
+        "label": rng.integers(0, 10, rows).astype(np.int32),
+    }
+
+
+# -- per-device slicing + global assembly ------------------------------------
+
+
+def test_place_batch_matches_make_global_batch_bitwise():
+    mesh = get_mesh()
+    assert len(jax.devices()) == 8  # conftest forced 8 CPU devices
+    plane = PlacementPlane(mesh, registry=MetricsRegistry())
+    batch = _batch(np.random.default_rng(0))
+    placed = plane.place_batch(batch)
+    ref = make_global_batch(batch, mesh)
+    for key in batch:
+        assert placed[key].shape == ref[key].shape
+        assert placed[key].sharding == ref[key].sharding
+        np.testing.assert_array_equal(
+            np.asarray(placed[key]), np.asarray(ref[key])
+        )
+    # Explicitly per-device: 16 rows over 8 devices -> 2-row shards.
+    assert placed["image"].sharding.spec == P("data")
+    assert placed["image"].addressable_shards[0].data.shape[0] == 2
+
+
+def test_place_batch_seq_axis_parity():
+    mesh = get_mesh(seq_parallelism=2)
+    plane = PlacementPlane(mesh, seq_axis="seq", registry=MetricsRegistry())
+    tokens = {
+        "tokens": np.random.default_rng(1).integers(
+            0, 100, (8, 16)
+        ).astype(np.int32)
+    }
+    placed = plane.place_batch(tokens)
+    ref = make_global_batch(tokens, mesh, seq_axis="seq")
+    assert placed["tokens"].sharding == ref["tokens"].sharding
+    assert placed["tokens"].sharding.spec == P("data", "seq")
+    np.testing.assert_array_equal(
+        np.asarray(placed["tokens"]), np.asarray(ref["tokens"])
+    )
+
+
+def test_placed_stream_bit_identical_to_sync_path(image_dataset):
+    """The acceptance pin: wrapping a host-batch pipeline in the plane
+    yields the same batch sequence, bit for bit, as the synchronous
+    ``device_put_fn`` arm over the same plan."""
+    mesh = get_mesh()
+    decode = ImageClassificationDecoder(image_size=32)
+    host = make_train_pipeline(image_dataset, "batch", 16, 0, 1, decode)
+    sync = make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1, decode,
+        device_put_fn=lambda b: make_global_batch(b, mesh),
+    )
+    plane = PlacementPlane(mesh, registry=MetricsRegistry())
+    placed_batches = list(plane.wrap(host))
+    sync_batches = list(sync)
+    assert len(placed_batches) == len(sync_batches) == len(host)
+    for got, want in zip(placed_batches, sync_batches):
+        for key in want:
+            assert got[key].sharding == want[key].sharding
+            np.testing.assert_array_equal(
+                np.asarray(got[key]), np.asarray(want[key])
+            )
+
+
+def test_placed_loader_delegates_len_set_epoch_and_counts(image_dataset):
+    registry = MetricsRegistry()
+    mesh = get_mesh()
+    plane = PlacementPlane(mesh, registry=registry)
+    inner = make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1,
+        ImageClassificationDecoder(image_size=32),
+    )
+    loader = plane.wrap(inner)
+    assert len(loader) == len(inner)
+    loader.set_epoch(3)  # DataPipeline has no set_epoch: must be a no-op
+    n = sum(1 for _ in loader)
+    assert n == len(inner)
+    # Satellite telemetry: per-batch H2D histogram + ring-depth gauge.
+    hist = registry.histogram("trainer_h2d_ms")
+    assert hist.count == n
+    assert registry.counter("placement_batches_placed").value == n
+    text = registry.render_prometheus()
+    assert "trainer_h2d_ms_bucket" in text
+    assert "placement_buffer_depth" in text
+
+
+def test_placed_iterator_propagates_decode_error(image_dataset):
+    def bad_decode(table):
+        raise RuntimeError("boom behind the plane")
+
+    mesh = get_mesh()
+    plane = PlacementPlane(mesh, registry=MetricsRegistry())
+    inner = make_train_pipeline(image_dataset, "batch", 16, 0, 1, bad_decode)
+    with pytest.raises(RuntimeError, match="boom behind the plane"):
+        list(plane.wrap(inner))
+
+
+# -- BufferPool lease lifecycle ----------------------------------------------
+
+
+def _drain_pool(pool, rounds=50):
+    """Sweep until jax's async-transfer references are dropped (CPU backend:
+    a handful of GC passes at most)."""
+    for _ in range(rounds):
+        gc.collect()
+        pool.sweep()
+        stats = pool.stats()
+        if stats["outstanding"] == 0 and stats["pending"] == 0:
+            return stats
+    return pool.stats()
+
+
+def test_leases_release_on_transfer_dispatch_not_pickup():
+    """The placement thread returns each host batch's leases right after
+    dispatching its transfers — by the time the CONSUMER first touches a
+    batch, its pages must already be back (outstanding only covers batches
+    still upstream of placement)."""
+    mesh = get_mesh()
+    pool = BufferPool(registry=MetricsRegistry())
+    plane = PlacementPlane(mesh, registry=MetricsRegistry(),
+                           buffer_pool=pool, depth=1)
+    rng = np.random.default_rng(2)
+
+    def leased_batches(n):
+        for _ in range(n):
+            batch = {"image": pool.lease((8, 4, 4, 3), np.uint8),
+                     "label": pool.lease((8,), np.int32)}
+            batch["image"][...] = rng.integers(0, 255, (8, 4, 4, 3))
+            batch["label"][...] = rng.integers(0, 10, 8)
+            yield batch
+
+    seen = 0
+    for batch in plane.iter_placed(leased_batches(6)):
+        seen += 1
+        # depth=1 ring: upstream holds at most the batch being placed plus
+        # the generator's in-flight one; everything older was released.
+        assert pool.stats()["outstanding"] <= 2 * 2  # 2 leaves x 2 batches
+        del batch
+    assert seen == 6
+    stats = _drain_pool(pool)
+    assert stats["outstanding"] == 0 and stats["pending"] == 0
+    assert stats["free"] > 0  # pages actually recycled, not dropped
+
+
+def test_abandoned_iterator_mid_ring_leaks_nothing():
+    """Consumer walks away after one batch with the ring full: teardown
+    must drain the ring and return every lease (the no-leak satellite)."""
+    mesh = get_mesh()
+    pool = BufferPool(registry=MetricsRegistry())
+    plane = PlacementPlane(mesh, registry=MetricsRegistry(),
+                           buffer_pool=pool, depth=2)
+
+    def leased_batches(n):
+        rng = np.random.default_rng(3)
+        for _ in range(n):
+            page = pool.lease((8, 4, 4, 3), np.uint8)
+            page[...] = rng.integers(0, 255, (8, 4, 4, 3))
+            yield {"image": page}
+
+    it = plane.iter_placed(leased_batches(10))
+    first = next(it)
+    assert isinstance(first["image"], jax.Array)
+    it.close()  # abandon mid-ring: generator finally drains + joins
+    del it, first
+    stats = _drain_pool(pool)
+    assert stats["outstanding"] == 0 and stats["pending"] == 0
+
+
+# -- fleet stripe → process mapping ------------------------------------------
+
+
+@pytest.mark.parametrize("n_members,n_procs", [
+    (1, 1), (2, 1), (4, 2), (5, 2), (8, 3), (7, 4), (12, 8),
+])
+def test_members_for_process_disjoint_and_covering(n_members, n_procs):
+    members = [{"server_id": f"s{i:02d}", "addr": f"h{i}:1"}
+               for i in range(n_members)]
+    slices = [members_for_process(members, p, n_procs)
+              for p in range(n_procs)]
+    # Deterministic: same inputs, same slices.
+    assert slices == [members_for_process(members, p, n_procs)
+                      for p in range(n_procs)]
+    flat = [m["server_id"] for s in slices for m in s]
+    # Disjoint and covering: every member served by exactly one process.
+    assert sorted(flat) == sorted(m["server_id"] for m in members)
+    assert len(set(flat)) == len(flat)
+    # Balanced within one.
+    sizes = [len(s) for s in slices]
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_members_for_process_fewer_members_than_processes():
+    members = [{"server_id": "a", "addr": "a:1"},
+               {"server_id": "b", "addr": "b:1"}]
+    slices = [members_for_process(members, p, 4) for p in range(4)]
+    # Every process still gets exactly one member (shared round-robin) and
+    # every member is used by someone.
+    assert all(len(s) == 1 for s in slices)
+    assert {s[0]["server_id"] for s in slices} == {"a", "b"}
+
+
+def test_members_for_process_stable_under_membership_growth():
+    """Adding a member must not reshuffle other processes' members wholesale
+    — slices stay contiguous in sorted-server_id order, so a join shifts at
+    most the boundary members."""
+    members = [{"server_id": f"s{i}", "addr": f"h{i}:1"} for i in range(6)]
+    before = members_for_process(members, 0, 2)
+    after = members_for_process(members + [
+        {"server_id": "s9", "addr": "h9:1"}
+    ], 0, 2)
+    assert [m["server_id"] for m in before][:3] == ["s0", "s1", "s2"]
+    assert [m["server_id"] for m in after][:3] == ["s0", "s1", "s2"]
+
+
+# -- ZeRO-1 optimizer-state sharding ------------------------------------------
+
+
+def test_zero_axis_shards_only_opt_state():
+    import optax
+    from flax.training import train_state
+
+    from lance_distributed_training_tpu.parallel.sharding import (
+        state_shardings,
+    )
+
+    class TS(train_state.TrainState):
+        batch_stats: object = None
+
+    params = {"dense": {"kernel": np.zeros((256, 256), np.float32),
+                        "bias": np.zeros((256,), np.float32)}}
+    state = TS.create(apply_fn=None, params=params, batch_stats=None,
+                      tx=optax.sgd(0.1, momentum=0.9))
+    mesh = get_mesh()
+    shardings = state_shardings(
+        jax.eval_shape(lambda: state), mesh, (), zero_axis="data",
+    )
+    kernel_opt = shardings.opt_state[0].trace["dense"]["kernel"]
+    assert kernel_opt.spec == P("data")  # momentum sharded 1/8 per device
+    assert shardings.params["dense"]["kernel"].spec == P()  # params replicated
+    # Small leaves stay replicated (latency-bound collectives buy nothing).
+    assert shardings.opt_state[0].trace["dense"]["bias"].spec == P()
+
+
+@pytest.mark.slow
+def test_zero_opt_trains_like_replicated(image_dataset, tmp_path):
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    common = dict(
+        dataset_path=image_dataset.uri, num_classes=10, image_size=32,
+        batch_size=16, epochs=1, max_steps=3, no_wandb=True,
+        eval_at_end=False, log_every=0, model_name="resnet18",
+        optimizer="adamw", lr=0.001,
+    )
+    base = train(TrainConfig(**common))
+    zero = train(TrainConfig(**common, zero_opt=True))
+    assert zero["loss"] == pytest.approx(base["loss"], rel=1e-5)
+
+
+def test_zero_and_fsdp_mutually_exclusive(tmp_path):
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        train(TrainConfig(dataset_path=str(tmp_path / "missing"),
+                          fsdp=True, zero_opt=True))
