@@ -57,6 +57,15 @@ class CheckConfig:
             "lance_distributed_training_tpu/data/buffers.py",
         ]
     )
+    # LDT901: state-persisting modules — files a RESTART reads and trusts
+    # (checkpoint cursors, lint baselines). Truncating in-place writes here
+    # must use tempfile + os.replace.
+    state_paths: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "lance_distributed_training_tpu/utils/checkpoint.py",
+            "lance_distributed_training_tpu/analysis/core.py",
+        ]
+    )
     # LDT701: the hot-path modules where materialising copies
     # (.to_pylist(), bytes(view[...])) undo the zero-copy batch plane.
     hot_paths: List[str] = dataclasses.field(
@@ -109,6 +118,7 @@ def load_config(root: str) -> CheckConfig:
         "protocol-module": "protocol_module",
         "obs-paths": "obs_paths",
         "hot-paths": "hot_paths",
+        "state-paths": "state_paths",
     }
     for key, attr in mapping.items():
         if key in section:

@@ -401,9 +401,30 @@ def write_baseline(
                 "message": f.message,
             }
         )
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump({"version": 1, "findings": entries}, f, indent=2)
-        f.write("\n")
+    # Atomic replace (the LDT901 discipline): the baseline is state every
+    # later `ldt check` trusts — a crash mid-write must leave the previous
+    # baseline, not a torn JSON that fails the gate everywhere. Deliberate
+    # duplication of utils/checkpoint.py:atomic_write_json: this module
+    # must stay stdlib-only (the gate runs standalone even when the
+    # training package — and its jax import — fails to load).
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(os.path.abspath(path)), prefix=".tmp-baseline-"
+    )
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump({"version": 1, "findings": entries}, f, indent=2)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def split_new_findings(

@@ -14,6 +14,7 @@ from . import (  # noqa: F401  (import for registration side effect)
     determinism,
     jit_purity,
     obs,
+    persistence,
     placement,
     protocol,
     resources,

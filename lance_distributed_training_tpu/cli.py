@@ -155,6 +155,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(same permutation on every process)")
     p.add_argument("--no_augment", action="store_true")
     p.add_argument("--eval_every", type=int, default=0)
+    p.add_argument("--no_eval_at_end", action="store_true",
+                   help="skip the final eval pass (smokes/benches that only "
+                        "need the train stream)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--run_name", type=str, default=None)
     p.add_argument("--metrics_port", type=int, default=None,
@@ -206,6 +209,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "checkpoint when one exists")
     p.add_argument("--checkpoint_every", type=int, default=1,
                    help="save every N epochs")
+    p.add_argument("--checkpoint_every_steps", type=int, default=0,
+                   help=">0: ALSO checkpoint every N data steps — step-"
+                        "granular, crash-consistent saves carrying the "
+                        "data-plane cursor, so a preempted run resumes "
+                        "mid-epoch at the exact next batch with a bit-"
+                        "identical stream (counted in absolute steps "
+                        "across restarts)")
     p.add_argument("--no_resume", action="store_true",
                    help="ignore existing checkpoints, start fresh")
     p.add_argument("--profile_dir", type=str, default=None,
@@ -514,6 +524,7 @@ def main(argv=None) -> dict:
         device_cache_gb=args.device_cache_gb,
         shuffle=args.shuffle,
         augment=not args.no_augment,
+        eval_at_end=not args.no_eval_at_end,
         eval_every=args.eval_every,
         seed=args.seed,
         run_name=args.run_name,
@@ -531,6 +542,7 @@ def main(argv=None) -> dict:
         pp_microbatches=args.pp_microbatches,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
+        checkpoint_every_steps=args.checkpoint_every_steps,
         resume=not args.no_resume,
         profile_dir=args.profile_dir,
         coordinator_address=args.coordinator_address,

@@ -99,9 +99,29 @@ class FolderDataPipeline:
         self.workers = workers
         self.producers = producers
         self.buffer_pool = buffer_pool
+        self._start_step = 0
+        self._yielded = 0
 
     def set_epoch(self, epoch: int) -> None:
-        self.epoch = epoch
+        if epoch != self.epoch:
+            self.epoch = epoch
+            self._start_step = 0
+            self._yielded = 0
+
+    def state_dict(self) -> dict:
+        """Resume cursor (contract: ``data/pipeline.py``) — the per-epoch
+        index plan is a pure function of (walk-ordered file list, shard,
+        seed, epoch), so (epoch, step) fully names the position."""
+        return {"epoch": int(self.epoch), "step": int(self._yielded)}
+
+    def load_state_dict(self, state: dict) -> None:
+        if "epoch" in state:
+            self.epoch = int(state["epoch"])
+        step = int(state.get("step", 0))
+        if step < 0:
+            raise ValueError(f"negative resume cursor: {step}")
+        self._start_step = step
+        self._yielded = step
 
     @property
     def num_classes(self) -> int:
@@ -153,4 +173,8 @@ class FolderDataPipeline:
             producers=self.producers,
             buffer_pool=self.buffer_pool,
         )
-        return iter(pipe)
+        pipe.load_state_dict({"step": self._start_step})
+        self._yielded = self._start_step
+        for batch in pipe:
+            self._yielded += 1
+            yield batch

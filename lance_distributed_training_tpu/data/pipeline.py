@@ -28,6 +28,25 @@ always bounded (``prefetch``, clamped >= 1) so decode can't run away from a
 slow consumer, and teardown uses drain-then-join: pop until the producer's
 blocked ``put()`` can observe the stop flag, then ``join`` with a timeout.
 ``service/server.py`` and ``service/client.py`` follow the same discipline.
+
+**Resume-cursor contract** (r8 — implemented by all five loaders:
+``DataPipeline``, ``MapStylePipeline``, ``FolderDataPipeline``,
+``RemoteLoader``, ``FleetLoader``, and passed through ``PlacedLoader``):
+
+* ``state_dict() -> {"step": n, ...}`` — ``n`` is the number of batches
+  HANDED TO the consumer this epoch (the count increments immediately
+  before each yield, so while the trainer runs its step on batch ``i`` the
+  cursor already reads ``i + 1`` — exactly the next batch a restart must
+  serve). Loaders that own an epoch also report ``"epoch"``.
+* ``load_state_dict({"step": n, ...})`` — position the loader so its next
+  iteration yields batch ``n`` of the (deterministically rebuilt) plan.
+  Because plans are pure functions of (dataset, sampler, batch, shard,
+  seed, epoch), the resumed tail is bit-identical to the uninterrupted
+  run's (``samplers.slice_plan``).
+
+The cursor is *position only*: checkpoints persist it next to the model
+state (``utils/checkpoint.py``) and the trainer rebuilds the loader from
+config before loading it.
 """
 
 from __future__ import annotations
@@ -51,6 +70,7 @@ from .samplers import (
     assert_equal_step_counts,
     distributed_index_batches,
     make_plan,
+    slice_plan,
 )
 
 __all__ = ["DataPipeline", "MapStylePipeline", "make_train_pipeline", "make_map_style_pipeline", "make_eval_pipeline"]
@@ -152,6 +172,22 @@ class DataPipeline:
         # consumer closes the loop into pipeline_decode_ms /
         # pipeline_batch_age_ms histograms on the process registry.
         self.registry = default_registry()
+        # Resume cursor (module docstring contract): _start_step positions
+        # the next iteration; _yielded counts batches handed out, absolute
+        # within the plan (seq/lineage stamps stay absolute too, so resumed
+        # telemetry lines up with the uninterrupted run's).
+        self._start_step = 0
+        self._yielded = 0
+
+    def state_dict(self) -> dict:
+        return {"step": int(self._yielded)}
+
+    def load_state_dict(self, state: dict) -> None:
+        step = int(state.get("step", 0))
+        if step < 0:
+            raise ValueError(f"negative resume cursor: {step}")
+        self._start_step = step
+        self._yielded = step
 
     def _release_host(self, batch) -> None:
         if self.buffer_pool is not None:
@@ -170,11 +206,15 @@ class DataPipeline:
     def __len__(self) -> int:
         return len(self.plan)
 
-    def _produce(self, q: "queue.Queue", stop: threading.Event) -> None:
+    def _produce(self, q: "queue.Queue", stop: threading.Event,
+                 plan: Sequence, base: int) -> None:
+        """``plan`` is the resume-sliced tail; ``base`` keeps seq/lineage
+        stamps absolute within the full plan."""
         try:
             if self.workers is not None:
-                it = self.workers.imap(self.plan)
-                for seq in range(len(self.plan)):
+                it = self.workers.imap(plan)
+                for off in range(len(plan)):
+                    seq = base + off
                     if stop.is_set():
                         return
                     t0 = time.monotonic_ns()
@@ -185,7 +225,8 @@ class DataPipeline:
                     decode_ms = (time.monotonic_ns() - t0) / 1e6
                     q.put((make_lineage(seq, decode_ms), out))
             else:
-                for seq, item in enumerate(self.plan):
+                for off, item in enumerate(plan):
+                    seq = base + off
                     if stop.is_set():
                         return
                     t0 = time.monotonic_ns()
@@ -234,8 +275,12 @@ class DataPipeline:
                 )
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
+        base = self._start_step
+        self._yielded = base
         producer = threading.Thread(
-            target=self._produce, args=(q, stop), daemon=True, name="ldt-producer"
+            target=self._produce,
+            args=(q, stop, slice_plan(self.plan, base), base),
+            daemon=True, name="ldt-producer",
         )
         producer.start()
         try:
@@ -249,6 +294,10 @@ class DataPipeline:
                 # Close the loop: creation→pickup age (prefetch-queue dwell
                 # + any consumer lag) and the stamped decode duration.
                 observe_local_lineage(self.registry, lineage)
+                # Cursor advances as the batch is handed out: mid-step the
+                # count already names the NEXT batch to serve (contract in
+                # the module docstring).
+                self._yielded += 1
                 host = batch
                 if self.device_put_fn is not None:
                     # device_put on the consumer thread: enqueues an async H2D
@@ -295,11 +344,14 @@ class DataPipeline:
         per = max(1, -(-max(self.prefetch, n) // n))
         queues = [queue.Queue(maxsize=per) for _ in range(n)]
         stop = threading.Event()
+        base = self._start_step
+        self._yielded = base
+        plan = slice_plan(self.plan, base)
 
         def produce(k: int) -> None:
             try:
-                for j, item in enumerate(self.plan[k::n]):
-                    seq = k + j * n
+                for j, item in enumerate(plan[k::n]):
+                    seq = base + k + j * n
                     if stop.is_set():
                         return
                     t0 = time.monotonic_ns()
@@ -350,6 +402,7 @@ class DataPipeline:
                     raise item
                 lineage, batch = item
                 observe_local_lineage(self.registry, lineage)
+                self._yielded += 1
                 yield batch
                 if self.device_put_fn is None:
                     # Host-batch consumers: release after the consumer's
@@ -534,9 +587,31 @@ class MapStylePipeline:
             if index_pool is not None
             else None
         )
+        self._start_step = 0
+        self._yielded = 0
 
     def set_epoch(self, epoch: int) -> None:
-        self.epoch = epoch
+        if epoch != self.epoch:
+            self.epoch = epoch
+            # A new epoch's plan starts at its own step 0; a stale cursor
+            # must not slice it.
+            self._start_step = 0
+            self._yielded = 0
+
+    def state_dict(self) -> dict:
+        """Resume cursor (contract: module docstring) — the per-epoch
+        index-batch plan is a pure function of (dataset, shard, seed,
+        epoch), so (epoch, step) fully names the position."""
+        return {"epoch": int(self.epoch), "step": int(self._yielded)}
+
+    def load_state_dict(self, state: dict) -> None:
+        if "epoch" in state:
+            self.epoch = int(state["epoch"])
+        step = int(state.get("step", 0))
+        if step < 0:
+            raise ValueError(f"negative resume cursor: {step}")
+        self._start_step = step
+        self._yielded = step
 
     def _index_batches(self) -> list[np.ndarray]:
         pool = self.index_pool
@@ -559,19 +634,24 @@ class MapStylePipeline:
         return len(self._index_batches())
 
     def __iter__(self) -> Iterator[dict]:
-        return iter(
-            DataPipeline(
-                self.dataset,
-                self._index_batches(),
-                self.decode_fn,
-                self.device_put_fn,
-                self.prefetch,
-                read_fn=_with_columns(_take_read, self.columns),
-                workers=self.workers,
-                producers=self.producers,
-                buffer_pool=self.buffer_pool,
-            )
+        pipe = DataPipeline(
+            self.dataset,
+            self._index_batches(),
+            self.decode_fn,
+            self.device_put_fn,
+            self.prefetch,
+            read_fn=_with_columns(_take_read, self.columns),
+            workers=self.workers,
+            producers=self.producers,
+            buffer_pool=self.buffer_pool,
         )
+        # The cursor lives HERE (this is the consumer-facing loader); the
+        # inner single-shot pipeline just starts at the same offset.
+        pipe.load_state_dict({"step": self._start_step})
+        self._yielded = self._start_step
+        for batch in pipe:
+            self._yielded += 1
+            yield batch
 
 
 def make_map_style_pipeline(dataset: Dataset, *args, **kwargs) -> MapStylePipeline:

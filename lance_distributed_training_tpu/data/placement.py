@@ -297,6 +297,8 @@ class PlacedLoader:
     def __init__(self, plane: PlacementPlane, inner):
         self.plane = plane
         self.inner = inner
+        self._start = 0
+        self._yielded = 0
 
     def __len__(self) -> int:
         return len(self.inner)
@@ -305,6 +307,32 @@ class PlacedLoader:
         set_epoch = getattr(self.inner, "set_epoch", None)
         if set_epoch is not None:
             set_epoch(epoch)
+        self._start = 0
+        self._yielded = 0
+
+    # -- resume cursor (contract: data/pipeline.py) -------------------------
+    #
+    # The count must live HERE, not on the inner loader: the placement
+    # thread runs the inner iterator up to `depth` batches AHEAD of the
+    # trainer, so the inner cursor counts decoded-and-placed batches while
+    # the checkpoint needs batches the trainer actually CONSUMED. On
+    # restore the ring's in-flight batches are simply re-decoded — device-
+    # resident state is never part of the cursor.
+
+    def state_dict(self) -> dict:
+        sd = {}
+        inner_sd = getattr(self.inner, "state_dict", None)
+        if inner_sd is not None:
+            sd.update(inner_sd())
+        sd["step"] = int(self._yielded)
+        return sd
+
+    def load_state_dict(self, state: dict) -> None:
+        inner_load = getattr(self.inner, "load_state_dict", None)
+        if inner_load is not None:
+            inner_load(state)
+        self._start = int(state.get("step", 0))
+        self._yielded = self._start
 
     @property
     def counters(self):
@@ -315,4 +343,10 @@ class PlacedLoader:
         return self.plane.counters
 
     def __iter__(self) -> Iterator:
-        return self.plane.iter_placed(self.inner)
+        # Count from the cursor THIS wrapper was loaded with — never from
+        # the inner loader's privates (any state_dict-compliant inner
+        # works, including future composed loaders).
+        self._yielded = self._start
+        for batch in self.plane.iter_placed(self.inner):
+            self._yielded += 1
+            yield batch

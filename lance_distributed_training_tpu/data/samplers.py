@@ -44,6 +44,7 @@ __all__ = [
     "padded_eval_index_batches",
     "assert_equal_step_counts",
     "make_plan",
+    "slice_plan",
 ]
 
 
@@ -388,6 +389,27 @@ def assert_equal_step_counts(
             raise RuntimeError(
                 f"step {step} rows {rows[0]} != batch_size {batch_size}"
             )
+
+
+def slice_plan(plan: Sequence, start_step: int) -> list:
+    """The tail of a per-process plan from ``start_step`` — the resume
+    cursor applied to the work list.
+
+    Because every plan here is a pure function of (dataset, sampler, batch,
+    shard, seed, epoch), a restarted process rebuilds the IDENTICAL plan and
+    slicing it at the cursor yields exactly the not-yet-consumed batches:
+    this is the invariant the loader ``state_dict()/load_state_dict()``
+    contract (``data/pipeline.py``) rests on, and what makes a
+    mid-epoch checkpoint resume bit-identical to the uninterrupted run.
+    ``start_step == len(plan)`` is valid (a checkpoint taken on the last
+    batch resumes into an empty tail); beyond it is a corrupt cursor and
+    raises rather than silently re-serving from 0.
+    """
+    if not 0 <= start_step <= len(plan):
+        raise ValueError(
+            f"resume cursor {start_step} outside plan of {len(plan)} steps"
+        )
+    return list(plan[start_step:])
 
 
 def _check_topology(process_index: int, process_count: int) -> None:

@@ -45,6 +45,7 @@ from typing import Callable, Iterator, Optional, Sequence
 from ..obs.lineage import observe_wire_lineage
 from ..obs.registry import MetricsRegistry, default_registry
 from ..utils.metrics import ServiceCounters
+from ..utils.retry import RetryPolicy, retrying
 from ..service import protocol as P
 
 __all__ = ["FleetLoader", "members_for_process"]
@@ -350,6 +351,23 @@ class FleetLoader:
         # addr -> monotonic deadline: members excluded from striping after a
         # failure, until the TTL lapses (a recovered server rejoins rounds).
         self._excluded: dict = {}
+        # Resume cursor (contract: data/pipeline.py): the merge loop's
+        # global cursor starts here — the same mechanism failover restriping
+        # uses, so a checkpoint resume IS a restripe from the saved step.
+        self._start_step = 0
+        self._yielded = 0
+
+    def state_dict(self) -> dict:
+        return {"epoch": int(self.epoch), "step": int(self._yielded)}
+
+    def load_state_dict(self, state: dict) -> None:
+        if "epoch" in state:
+            self.set_epoch(int(state["epoch"]))
+        step = int(state.get("step", 0))
+        if step < 0:
+            raise ValueError(f"negative resume cursor: {step}")
+        self._start_step = step
+        self._yielded = step
 
     # -- coordinator --------------------------------------------------------
 
@@ -381,50 +399,47 @@ class FleetLoader:
         which case the exclusions are dropped (a possibly-recovered server
         beats certain starvation)."""
         last: Optional[Exception] = None
-        backoff = self.backoff_s
-        for _ in range(self.resolve_retries):
-            if stop is not None and stop.is_set():
-                raise ConnectionError("loader closed during resolve")
+        policy = RetryPolicy(
+            attempts=self.resolve_retries, base_s=self.backoff_s, cap_s=2.0
+        )
+        for _attempt in retrying(
+            policy, stop=stop, registry=self.registry,
+            interrupt_message="loader closed during resolve",
+        ):
             try:
                 reply = self._resolve_once()
             except (ConnectionError, OSError, P.ProtocolError) as exc:
                 last = exc
                 self.counters.add("resolve_errors")
-            else:
-                self.counters.add("resolves")
-                self.generation = int(reply.get("generation", 0))
-                self.counters.gauge("lease_generation", self.generation)
-                members = sorted(
-                    reply.get("members", []),
-                    key=lambda m: str(m.get("server_id", "")),
-                )
-                self.counters.gauge("members", len(members))
-                # Slice BEFORE exclusion: the process→member mapping must
-                # stay stable across failover rounds (an exclusion on host
-                # A must not shift host B's stripes onto new servers).
-                mine = members_for_process(
-                    members, self.process_index, self.process_count
-                )
-                self.counters.gauge("members_assigned", len(mine))
-                now = time.monotonic()
-                self._excluded = {
-                    a: t for a, t in self._excluded.items() if t > now
-                }
-                live = [
-                    m for m in mine
-                    if m.get("addr") not in self._excluded
-                ]
-                if not live:
-                    live = mine  # all excluded: try everyone again
-                if live:
-                    return live
-                last = ConnectionError("fleet has no registered members")
-            if stop is not None:
-                if stop.wait(backoff):
-                    raise ConnectionError("loader closed during resolve")
-            else:
-                time.sleep(backoff)
-            backoff = min(backoff * 2, 2.0)
+                continue
+            self.counters.add("resolves")
+            self.generation = int(reply.get("generation", 0))
+            self.counters.gauge("lease_generation", self.generation)
+            members = sorted(
+                reply.get("members", []),
+                key=lambda m: str(m.get("server_id", "")),
+            )
+            self.counters.gauge("members", len(members))
+            # Slice BEFORE exclusion: the process→member mapping must
+            # stay stable across failover rounds (an exclusion on host
+            # A must not shift host B's stripes onto new servers).
+            mine = members_for_process(
+                members, self.process_index, self.process_count
+            )
+            self.counters.gauge("members_assigned", len(mine))
+            now = time.monotonic()
+            self._excluded = {
+                a: t for a, t in self._excluded.items() if t > now
+            }
+            live = [
+                m for m in mine
+                if m.get("addr") not in self._excluded
+            ]
+            if not live:
+                live = mine  # all excluded: try everyone again
+            if live:
+                return live
+            last = ConnectionError("fleet has no registered members")
         raise ConnectionError(
             f"fleet coordinator {self.coordinator_host}:"
             f"{self.coordinator_port}: no usable membership after "
@@ -462,10 +477,13 @@ class FleetLoader:
         reject our plan parameters cannot be failed over to."""
         host, port = P.parse_hostport(addr)
         last: Optional[Exception] = None
-        backoff = self.backoff_s
-        for attempt in range(self.connect_retries):
-            if stop is not None and stop.is_set():
-                raise ConnectionError("loader closed during connect")
+        policy = RetryPolicy(
+            attempts=self.connect_retries, base_s=self.backoff_s, cap_s=2.0
+        )
+        for _attempt in retrying(
+            policy, stop=stop, registry=self.registry,
+            interrupt_message="loader closed during connect",
+        ):
             sock = None
             try:
                 sock = socket.create_connection(
@@ -509,15 +527,6 @@ class FleetLoader:
                     sock.close()
                 last = exc
                 self.counters.add("connect_retries")
-                if attempt + 1 < self.connect_retries:
-                    if stop is not None:
-                        if stop.wait(backoff):
-                            raise ConnectionError(
-                                "loader closed during connect"
-                            ) from exc
-                    else:
-                        time.sleep(backoff)
-                    backoff = min(backoff * 2, 2.0)
         raise ConnectionError(
             f"data server {addr} unreachable after "
             f"{self.connect_retries} attempts: {last}"
@@ -551,6 +560,9 @@ class FleetLoader:
         if epoch != self.epoch:
             self.epoch = epoch
             self._num_steps = None
+            # A new epoch's plan starts at its own step 0.
+            self._start_step = 0
+            self._yielded = 0
 
     def _release(self, batch) -> None:
         if self.buffer_pool is not None:
@@ -561,7 +573,10 @@ class FleetLoader:
     def _receive(self, q: "queue.Queue", stop: threading.Event) -> None:
         """Orchestrator thread: stripe rounds → merged plan-order stream
         into the bounded queue, restriping from the cursor on member loss."""
-        cursor = 0  # first step not yet handed to the consumer
+        # First step not yet handed to the consumer. Starts at the loaded
+        # checkpoint cursor: resume after a trainer restart is the same
+        # restripe-from-cursor move failover already makes mid-run.
+        cursor = self._start_step
         try:
             if self._num_steps is None:
                 self.__len__()  # probe via any member (retries inside)
@@ -576,7 +591,7 @@ class FleetLoader:
                     self._failover(f, cursor)
                     continue
                 self.counters.gauge("stripes", rnd.count)
-                if cursor > 0:
+                if cursor > self._start_step:
                     # Failover restripe cost, dial-to-streaming. The initial
                     # stripe setup is not a REbalance and stays out.
                     self.counters.observe(
@@ -616,6 +631,7 @@ class FleetLoader:
             name="ldt-fleet-loader",
         )
         receiver.start()
+        self._yielded = self._start_step
         try:
             while True:
                 t0 = time.perf_counter()
@@ -628,6 +644,7 @@ class FleetLoader:
                     return
                 if isinstance(item, BaseException):
                     raise item
+                self._yielded += 1
                 host = item
                 if self.device_put_fn is not None:
                     item = self.device_put_fn(host)

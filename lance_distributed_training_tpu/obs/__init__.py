@@ -20,6 +20,18 @@ say *where it was born* — fragment read vs decode vs queue vs wire vs H2D.
 
 Deliberately dependency-free (stdlib only; jax is optional) so decode-only
 service hosts carry the same telemetry as trainers.
+
+Robustness series (r8, recorded by ``utils/checkpoint.py`` /
+``utils/signals.py`` / ``utils/retry.py`` into the default registry):
+
+* ``ckpt_save_ms`` — histogram of checkpoint save dispatch (+ commit wait
+  for awaited emergency saves);
+* ``ckpt_last_success_step`` — gauge: the newest persisted absolute step
+  (stale vs ``trainer_step_ms_count`` = the save plane is wedged);
+* ``trainer_preemptions_total`` — counter: SIGTERM (or chaos) drains;
+* ``retry_attempts_total`` — counter: reconnect retries across ALL
+  subsystems (client connects, fleet resolves/dials) after unification in
+  ``utils/retry.py``.
 """
 
 from .http import MetricsHTTPServer  # noqa: F401

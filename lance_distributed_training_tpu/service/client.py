@@ -36,11 +36,17 @@ from ..obs.lineage import observe_wire_lineage
 from ..obs.registry import MetricsRegistry, default_registry
 from ..obs.spans import span
 from ..utils.metrics import ServiceCounters
+from ..utils.retry import RetryPolicy, retrying
 from . import protocol as P
 
 __all__ = ["RemoteLoader"]
 
 _SENTINEL = object()
+
+
+class _VersionRedial(Exception):
+    """Handshake version negotiation: redial immediately with the
+    downgraded HELLO — never surfaced, never counted as a failed attempt."""
 
 
 class RemoteLoader:
@@ -115,6 +121,24 @@ class RemoteLoader:
         # Set by the active iteration; test/ops hook: closing it simulates a
         # connection drop and exercises the resume path.
         self._conn: Optional[socket.socket] = None
+        # Resume cursor (contract: data/pipeline.py): _start_step rides the
+        # next iteration's HELLO as start_step — the server slices its
+        # (identical, deterministic) plan there, the same mechanism
+        # mid-epoch reconnects already use.
+        self._start_step = 0
+        self._yielded = 0
+
+    def state_dict(self) -> dict:
+        return {"epoch": int(self.epoch), "step": int(self._yielded)}
+
+    def load_state_dict(self, state: dict) -> None:
+        if "epoch" in state:
+            self.set_epoch(int(state["epoch"]))
+        step = int(state.get("step", 0))
+        if step < 0:
+            raise ValueError(f"negative resume cursor: {step}")
+        self._start_step = step
+        self._yielded = step
 
     # -- connection management --------------------------------------------
 
@@ -138,104 +162,109 @@ class RemoteLoader:
 
     def _connect(self, start_step: int, probe: bool = False,
                  stop: Optional[threading.Event] = None):
-        """Dial + handshake, with retry/backoff. Returns ``(sock, reply)``.
+        """Dial + handshake, with retry/backoff (the shared
+        ``utils/retry.py`` policy: full jitter, 10 s cap). Returns
+        ``(sock, reply)``.
 
         ``stop`` (the iteration's shutdown event) aborts between attempts
         and shortens backoff sleeps, so closing an iterator mid-outage
         returns promptly instead of draining the full retry schedule."""
         last: Optional[Exception] = None
-        attempt, attempts = 0, max(1, self.connect_retries)
-        while attempt < attempts:
-            if stop is not None and stop.is_set():
-                raise ConnectionError("loader closed during connect")
-            sock = None
+        policy = RetryPolicy(
+            attempts=max(1, self.connect_retries), base_s=self.backoff_s
+        )
+        for _attempt in retrying(
+            policy, stop=stop, registry=self.registry,
+            interrupt_message="loader closed during connect",
+        ):
             try:
-                # Short dial timeout: create_connection cannot be interrupted
-                # by the stop event, so an unreachable host must fail fast
-                # (the retry loop provides persistence, not the dial).
-                sock = socket.create_connection(
-                    (self.host, self.port),
-                    timeout=min(self.timeout_s, 10.0),
-                )
-                sock.settimeout(self.timeout_s)  # handshake recv bound
-                if stop is not None:
-                    # Expose the in-progress socket so a concurrent iterator
-                    # close() can break a handshake recv out of its full
-                    # timeout (a half-dead server that accepts but never
-                    # replies would otherwise pin teardown for timeout_s).
-                    self._conn = sock
-                    if stop.is_set():
-                        raise ConnectionError("loader closed during connect")
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                P.send_msg(sock, P.MSG_HELLO, self._hello(start_step, probe))
-                msg_type, reply = P.recv_msg(sock)
-                if msg_type == P.MSG_ERROR:
-                    message = str(reply.get("message", ""))
-                    if (P.VERSION_MISMATCH_MARKER in message
-                            and self._hello_version
-                            > P.MIN_PROTOCOL_VERSION):
-                        # A v1 server's handshake predates range negotiation
-                        # and rejects any version but its own. Re-offer the
-                        # oldest version this build still speaks (lineage is
-                        # already gated on the peer's echoed version, so a
-                        # downgraded stream simply never carries it). The
-                        # redial is free — the server IS reachable, this is
-                        # negotiation, not a failed attempt — and happens at
-                        # most once (guarded by the version floor above).
-                        self._hello_version = P.MIN_PROTOCOL_VERSION
-                        sock.close()
+                while True:
+                    try:
+                        return self._dial_once(start_step, probe, stop)
+                    except _VersionRedial:
+                        # The server IS reachable — this is negotiation,
+                        # not a failed attempt: redial immediately without
+                        # consuming a retry (it happens at most once,
+                        # guarded by the version floor in _dial_once).
                         continue
-                    # Other handshake rejections (bad shard, decode-config
-                    # skew) are permanent — retrying cannot fix them.
-                    raise P.ProtocolError(
-                        f"server rejected handshake: {message}"
-                    )
-                if msg_type != P.MSG_HELLO_OK:
-                    raise P.ProtocolError(
-                        f"expected HELLO_OK, got message type {msg_type}"
-                    )
-                # An old (v1) server is fine — it just never sends lineage;
-                # only a version OUTSIDE the range is a hard skew. (Servers
-                # reject those at HELLO, but a v1 server predates range
-                # checks, so the client re-checks its echo.)
-                if not P.version_supported(reply.get("version")):
-                    raise P.ProtocolError(
-                        f"server speaks protocol {reply.get('version')}, "
-                        f"client supports {P.MIN_PROTOCOL_VERSION}.."
-                        f"{P.PROTOCOL_VERSION}"
-                    )
-                self._num_steps = int(reply["num_steps"])
-                # Streaming phase: no recv deadline. A slow step (cold
-                # decode, read retries, busy shared pool) must NOT be
-                # misread as a drop — a timeout here would reconnect and
-                # make the server restart the same step's decode, livelocking
-                # when a step reliably exceeds the timeout. Dead peers are
-                # covered by TCP keepalive + close() unblocking the recv.
-                sock.settimeout(None)
-                sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
-                return sock, reply
-            except P.ProtocolError:
-                if sock is not None:
-                    sock.close()
-                raise
             except (ConnectionError, OSError) as exc:
-                if sock is not None:
-                    sock.close()
                 last = exc
                 self.counters.add("connect_retries")
-                backoff = self.backoff_s * (2**attempt)
-                attempt += 1
-                if stop is not None:
-                    if stop.wait(backoff):  # interruptible backoff
-                        raise ConnectionError(
-                            "loader closed during connect"
-                        ) from exc
-                else:
-                    time.sleep(backoff)
         raise ConnectionError(
             f"data service {self.host}:{self.port} unreachable after "
             f"{self.connect_retries} attempts: {last}"
         ) from last
+
+    def _dial_once(self, start_step: int, probe: bool,
+                   stop: Optional[threading.Event]):
+        """One dial + handshake. Raises ``_VersionRedial`` after arranging a
+        downgraded HELLO, ``ProtocolError`` on permanent rejections (bad
+        shard, decode-config skew — retrying cannot fix them), and
+        ``ConnectionError``/``OSError`` on retryable transport failures."""
+        sock = None
+        try:
+            # Short dial timeout: create_connection cannot be interrupted
+            # by the stop event, so an unreachable host must fail fast
+            # (the retry loop provides persistence, not the dial).
+            sock = socket.create_connection(
+                (self.host, self.port),
+                timeout=min(self.timeout_s, 10.0),
+            )
+            sock.settimeout(self.timeout_s)  # handshake recv bound
+            if stop is not None:
+                # Expose the in-progress socket so a concurrent iterator
+                # close() can break a handshake recv out of its full
+                # timeout (a half-dead server that accepts but never
+                # replies would otherwise pin teardown for timeout_s).
+                self._conn = sock
+                if stop.is_set():
+                    raise ConnectionError("loader closed during connect")
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            P.send_msg(sock, P.MSG_HELLO, self._hello(start_step, probe))
+            msg_type, reply = P.recv_msg(sock)
+            if msg_type == P.MSG_ERROR:
+                message = str(reply.get("message", ""))
+                if (P.VERSION_MISMATCH_MARKER in message
+                        and self._hello_version
+                        > P.MIN_PROTOCOL_VERSION):
+                    # A v1 server's handshake predates range negotiation
+                    # and rejects any version but its own. Re-offer the
+                    # oldest version this build still speaks (lineage is
+                    # already gated on the peer's echoed version, so a
+                    # downgraded stream simply never carries it).
+                    self._hello_version = P.MIN_PROTOCOL_VERSION
+                    raise _VersionRedial()
+                raise P.ProtocolError(
+                    f"server rejected handshake: {message}"
+                )
+            if msg_type != P.MSG_HELLO_OK:
+                raise P.ProtocolError(
+                    f"expected HELLO_OK, got message type {msg_type}"
+                )
+            # An old (v1) server is fine — it just never sends lineage;
+            # only a version OUTSIDE the range is a hard skew. (Servers
+            # reject those at HELLO, but a v1 server predates range
+            # checks, so the client re-checks its echo.)
+            if not P.version_supported(reply.get("version")):
+                raise P.ProtocolError(
+                    f"server speaks protocol {reply.get('version')}, "
+                    f"client supports {P.MIN_PROTOCOL_VERSION}.."
+                    f"{P.PROTOCOL_VERSION}"
+                )
+            self._num_steps = int(reply["num_steps"])
+            # Streaming phase: no recv deadline. A slow step (cold
+            # decode, read retries, busy shared pool) must NOT be
+            # misread as a drop — a timeout here would reconnect and
+            # make the server restart the same step's decode, livelocking
+            # when a step reliably exceeds the timeout. Dead peers are
+            # covered by TCP keepalive + close() unblocking the recv.
+            sock.settimeout(None)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+            return sock, reply
+        except BaseException:
+            if sock is not None:
+                sock.close()
+            raise
 
     def __len__(self) -> int:
         """Step count of this shard's plan (probe handshake, cached)."""
@@ -251,6 +280,9 @@ class RemoteLoader:
         if epoch != self.epoch:
             self.epoch = epoch
             self._num_steps = None
+            # A new epoch's plan starts at its own step 0.
+            self._start_step = 0
+            self._yielded = 0
 
     def _release(self, batch) -> None:
         if self.buffer_pool is not None:
@@ -261,7 +293,11 @@ class RemoteLoader:
     def _receive(self, q: "queue.Queue", stop: threading.Event) -> None:
         """Receiver thread: stream frames into the bounded queue, ACK each
         received step, reconnect at the cursor on connection loss."""
-        next_step = 0  # resume cursor: first step not yet enqueued
+        # Resume cursor: first step not yet enqueued. Starts at the loaded
+        # checkpoint cursor (0 on a fresh epoch) — a restarted trainer's
+        # first HELLO asks for exactly the next unconsumed step, the same
+        # server-side plan slice mid-epoch reconnects use.
+        next_step = self._start_step
         sock: Optional[socket.socket] = None
         try:
             sock, _ = self._connect(next_step, stop=stop)
@@ -352,6 +388,7 @@ class RemoteLoader:
             name="ldt-remote-loader",
         )
         receiver.start()
+        self._yielded = self._start_step
         try:
             while True:
                 t0 = time.perf_counter()
@@ -364,6 +401,7 @@ class RemoteLoader:
                     return
                 if isinstance(item, BaseException):
                     raise item
+                self._yielded += 1
                 host = item
                 if self.device_put_fn is not None:
                     item = self.device_put_fn(host)

@@ -215,6 +215,13 @@ class TrainConfig:
     # -- aux subsystems the reference lacks (SURVEY.md §5) --
     checkpoint_dir: Optional[str] = None  # orbax save/restore root
     checkpoint_every: int = 1  # save every N epochs
+    checkpoint_every_steps: int = 0  # >0: ALSO save every N data steps —
+    # step-granular, crash-consistent checkpoints carrying the data-plane
+    # cursor (loader state_dict + host rng + counters), so a SIGKILLed run
+    # restarts mid-epoch at the exact next batch with a bit-identical
+    # stream. Counted in absolute data steps across restarts; with
+    # data_echo > 1 saves land at host-batch boundaries. Epoch-boundary
+    # saves (checkpoint_every) continue independently.
     resume: bool = True  # restore the latest checkpoint if one exists
     profile_dir: Optional[str] = None  # jax.profiler trace of early steps
     # -- multi-host rendezvous (torchrun MASTER_ADDR/RANK/WORLD_SIZE parity) --
@@ -922,6 +929,35 @@ def maybe_enable_compile_cache(platform: str, cache_dir: Optional[str] = None,
     return cache_dir
 
 
+class _CkptJournal:
+    """Checkpoint bookkeeping shared between the step loop and ``train()``'s
+    ``finally`` (the emergency-save path). Updated only at completed-step
+    boundaries, so whatever it holds always pairs a model state with the
+    cursor naming the exact next batch — a signal or exception arriving
+    mid-step can never save an inconsistent pair."""
+
+    def __init__(self, resume_global_step: int = 0):
+        self.state = None  # latest post-step TrainState (a reference)
+        self.rng = None  # the key as of the same boundary
+        self.cursor_base: Optional[dict] = None  # loader {"epoch","step"}
+        self.abs_step = resume_global_step  # absolute completed data steps
+        self.saved_step = resume_global_step  # newest persisted abs_step
+        self.preempted = False
+
+    @property
+    def dirty(self) -> bool:
+        return self.state is not None and self.abs_step > self.saved_step
+
+    def make_cursor(self) -> dict:
+        from .utils.checkpoint import pack_rng_key
+
+        cursor = dict(self.cursor_base or {})
+        cursor["global_step"] = int(self.abs_step)
+        if self.rng is not None:
+            cursor["rng"] = pack_rng_key(self.rng)
+        return cursor
+
+
 def train(config: TrainConfig) -> dict:
     """The single training entry point. Returns final metrics."""
     if config.val_fraction:
@@ -1131,23 +1167,70 @@ def train(config: TrainConfig) -> dict:
     global_step = 0
 
     # Checkpoint/resume — preemption recovery the reference delegates to its
-    # launcher with nothing to restore (SURVEY.md §5). The saved step index is
-    # "epochs completed"; resume re-enters the epoch loop there.
+    # launcher with nothing to restore (SURVEY.md §5). Checkpoints are
+    # step-granular and crash-consistent (utils/checkpoint.py): the newest
+    # INTACT step restores model + optimizer state together with the
+    # data-plane cursor (epoch, batches consumed, absolute step, host rng),
+    # so the resumed stream — and with it the loss trajectory — is
+    # bit-identical to the uninterrupted run. Corrupt/partial checkpoints
+    # (the previous preemption's torn write) fall back to the step before.
     ckpt = None
     start_epoch = 0
+    resume_epoch_step = 0  # batches already consumed within start_epoch
+    resume_global_step = 0  # absolute data steps completed before this run
     if config.checkpoint_dir:
-        from .utils.checkpoint import CheckpointManager
+        from .utils.checkpoint import CheckpointManager, unpack_rng_key
 
         ckpt = CheckpointManager(config.checkpoint_dir)
         if config.resume:
-            latest = ckpt.latest_step()
-            if latest is not None:
-                state = ckpt.restore(state)
-                start_epoch = min(latest, config.epochs)
-                # The per-step rng stream continues from the resume point; it
-                # differs from an uninterrupted run (masking/augment draws),
-                # which is fine — only the fold order changes, not the data.
-                rng = jax.random.fold_in(rng, start_epoch)
+            restored = ckpt.restore_latest(state)
+            if restored is not None:
+                state, cursor, ck_step = restored
+                if cursor is not None:
+                    start_epoch = min(
+                        int(cursor.get("epoch", 0)), config.epochs
+                    )
+                    resume_epoch_step = (
+                        int(cursor.get("step", 0))
+                        if start_epoch < config.epochs else 0
+                    )
+                    resume_global_step = int(
+                        cursor.get("global_step", ck_step)
+                    )
+                    packed = cursor.get("rng")
+                    if packed is not None:
+                        # Exact key restore: the split sequence (and the
+                        # on-device augment/masking draws) continues bit-
+                        # identically to the uninterrupted run.
+                        rng = unpack_rng_key(packed)
+                    else:
+                        rng = jax.random.fold_in(rng, start_epoch)
+                else:
+                    # Legacy cursorless checkpoint: the step index is
+                    # "epochs completed"; resume at the epoch boundary with
+                    # the historical fold-in rng (stream position is intact,
+                    # only the masking/augment draw order differs).
+                    start_epoch = min(ck_step, config.epochs)
+                    resume_global_step = int(state.step)
+                    rng = jax.random.fold_in(rng, start_epoch)
+
+    # Preemption handling: SIGTERM (k8s eviction, TPU maintenance) sets a
+    # flag the step loop polls — the in-flight step finishes, an emergency
+    # checkpoint is awaited, the placement ring drains, and train() returns
+    # normally (exit 0). The deterministic chaos harness (utils/chaos.py,
+    # LDT_CHAOS env) drives the same paths at an exact step for tests/CI.
+    from .utils.chaos import StepTrace, TrainerChaos
+    from .utils.signals import PreemptionHandler
+
+    # Parse chaos/trace BEFORE installing the handler: a malformed
+    # LDT_CHAOS spec raises by design, and must not leak a hijacked
+    # SIGTERM disposition behind it.
+    chaos = TrainerChaos.from_env()
+    trace = StepTrace.from_env()
+    preempt = PreemptionHandler().install()
+    if chaos is not None:
+        chaos.drain_cb = preempt.request
+    journal = _CkptJournal(resume_global_step)
 
     profiling = False
 
@@ -1157,6 +1240,7 @@ def train(config: TrainConfig) -> dict:
     # /healthz liveness body, for the lifetime of the run.
     exporter = None
     worker_pool = None
+    run_exc: Optional[BaseException] = None
     try:
         # Everything that can fail lives inside the try — a bind failure on
         # the exporter port, the metrics_port log write, or a pool-spawn
@@ -1182,7 +1266,13 @@ def train(config: TrainConfig) -> dict:
             eval_step, logger, timer, worker_pool, ckpt, start_epoch,
             total_start, n_devices, results, global_step, profiling,
             index_pool, lr_schedule_fn(config, total_steps), val_pool,
+            resume_epoch_step=resume_epoch_step,
+            resume_global_step=resume_global_step,
+            preempt=preempt, chaos=chaos, trace=trace, journal=journal,
         )
+    except BaseException as exc:
+        run_exc = exc
+        raise
     finally:
         if config.profile_dir:
             try:  # stop a trace left open by a mid-window exception
@@ -1193,15 +1283,44 @@ def train(config: TrainConfig) -> dict:
             exporter.stop()
         if worker_pool is not None:
             worker_pool.shutdown()
-        if ckpt is not None:
-            ckpt.close()
-        logger.close()
+        try:
+            if ckpt is not None:
+                # The crash-path save gap (r8): a preempted OR crashed run
+                # must persist its last completed step — AWAITED — before
+                # the process exits; ckpt.close() additionally waits out
+                # any periodic save still committing in the background.
+                try:
+                    if journal.dirty and (journal.preempted
+                                          or run_exc is not None):
+                        if ckpt.save(journal.abs_step, journal.state,
+                                     cursor=journal.make_cursor(),
+                                     wait=True):
+                            journal.saved_step = journal.abs_step
+                finally:
+                    ckpt.close()
+        except Exception:
+            # A failed emergency save must fail a SIGTERM drain loudly
+            # (never exit 0 claiming a checkpoint it didn't take) — but on
+            # the crash path it must not mask the original run exception.
+            if run_exc is None:
+                raise
+        finally:
+            # Teardown that must survive a failed save: the process-wide
+            # SIGTERM disposition, the trace file, and the metric sinks.
+            preempt.uninstall()
+            if trace is not None:
+                trace.close()
+            logger.close()
 
 
 def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 eval_step, logger, timer, worker_pool, ckpt, start_epoch,
                 total_start, n_devices, results, global_step, profiling,
-                index_pool=None, lr_fn=None, val_pool=None):
+                index_pool=None, lr_fn=None, val_pool=None, *,
+                resume_epoch_step=0, resume_global_step=0, preempt=None,
+                chaos=None, trace=None, journal=None):
+    if journal is None:
+        journal = _CkptJournal(resume_global_step)
     # HBM-resident dataset cache (--device_cache): filled on the first
     # executed epoch, replayed afterwards. See TrainConfig.device_cache.
     cache: list = []
@@ -1224,6 +1343,9 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
     )
     stop = False  # set by max_steps; ends the epoch loop after bookkeeping
     for epoch in range(start_epoch, config.epochs):
+        # Mid-epoch resume cursor: batches of THIS epoch already consumed
+        # by the checkpointed run (first epoch after a restart only).
+        resume_step = resume_epoch_step if epoch == start_epoch else 0
         replay = cache_ok and epoch > start_epoch and len(cache) > 0
         if replay:
             if config.shuffle or config.loader_style == "map":
@@ -1237,6 +1359,11 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
         else:
             loader = _build_loader(config, dataset, mesh, epoch, worker_pool,
                                    index_pool=index_pool)
+            if resume_step:
+                # Position the loader at the cursor: the rebuilt plan is
+                # deterministic, so the tail it serves is bit-identical to
+                # what the uninterrupted run would have consumed.
+                loader.load_state_dict({"epoch": epoch, "step": resume_step})
             it = iter(loader)
         # RemoteLoader exposes ServiceCounters: merge its stall/queue window
         # into per-step progress lines so loader-stall% stays attributable
@@ -1248,11 +1375,15 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             getattr(loader, "placement_counters", None)
             if loader is not None else None,
         )
-        filling = cache_ok and not replay
+        # A partially-resumed epoch must not seed the replay cache: it
+        # would capture only the post-resume tail and later epochs would
+        # silently train on a subset.
+        filling = cache_ok and not replay and not resume_step
         timer.reset()
         epoch_start = time.perf_counter()
         loss_sum = jnp.zeros((), jnp.float32)  # stays on device all epoch
         epoch_step = 0
+        epoch_batches = resume_step  # host batches consumed this epoch
         while True:
             timer.loader_start()
             with obs_span("train.loader", step=global_step):
@@ -1260,6 +1391,7 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             timer.loader_stop()
             if batch is None:
                 break
+            epoch_batches += 1
             if filling:
                 if not cache:
                     per_batch = _per_device_batch_bytes(batch)
@@ -1332,6 +1464,12 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 timer.step_stop()
                 global_step += 1
                 epoch_step += 1
+                if trace is not None:
+                    # Resume-fidelity instrument (LDT_STEP_TRACE_PATH):
+                    # absolute step + batch hash + loss, compared step-for-
+                    # step against a control arm by the chaos harness.
+                    trace.record(resume_global_step + global_step, epoch,
+                                 batch, loss)
                 if 0 < config.max_steps <= global_step:
                     stop = True
                 if config.log_every and global_step % config.log_every == 0:
@@ -1393,9 +1531,55 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                     logger.log(entry, to_wandb=False)
                 if stop:
                     break
+            # Step boundary: the journal always pairs the post-step model
+            # state with the cursor naming the NEXT batch (the loader's
+            # state_dict reads "batches handed out", which at this point
+            # equals batches consumed — see the data/pipeline.py contract).
+            journal.state = state
+            journal.rng = rng
+            journal.abs_step = resume_global_step + global_step
+            if loader is not None and hasattr(loader, "state_dict"):
+                cursor_base = dict(loader.state_dict())
+                cursor_base.setdefault("epoch", epoch)
+            else:
+                # device_cache replay arm: the cached stream is the FROZEN
+                # epoch-0 batch set under a cache-local permutation — for
+                # shuffled/map configs a cacheless restart building the
+                # fresh epoch-e plan would serve a DIFFERENT set/order, so
+                # a mid-epoch cursor here would silently skip and repeat
+                # samples. Pin the epoch start instead: a restart re-runs
+                # this epoch from storage — deterministic over-training of
+                # up to one epoch, never silently lost data.
+                cursor_base = {"epoch": epoch, "step": 0}
+            journal.cursor_base = cursor_base
+            if (
+                ckpt is not None
+                and config.checkpoint_every_steps > 0
+                and journal.abs_step
+                >= journal.saved_step + config.checkpoint_every_steps
+            ):
+                # Async step checkpoint (the epoch-boundary save awaits via
+                # ckpt.close()); ">= saved + N" rather than "% N" so
+                # data_echo's multi-step jumps can't skip the trigger.
+                if ckpt.save(journal.abs_step, state,
+                             cursor=journal.make_cursor()):
+                    journal.saved_step = journal.abs_step
+            if chaos is not None:
+                chaos.on_step(global_step)
+            if preempt is not None and preempt.requested and not stop:
+                # Orchestrated preemption (SIGTERM): the in-flight step has
+                # finished; drain the loader/placement ring below and let
+                # train()'s finally take the awaited emergency checkpoint.
+                journal.preempted = True
+                logger.log({"preempted": True,
+                            "at_step": journal.abs_step,
+                            "epoch": epoch}, to_wandb=False)
+                stop = True
             if stop:
-                # max_steps reached mid-epoch: close the loader's generator
-                # so producer threads observe the stop flag and drain.
+                # max_steps / preemption mid-epoch: close the loader's
+                # generator so producer threads and the placement ring
+                # observe the stop flag, drain, and release their
+                # BufferPool leases.
                 if hasattr(it, "close"):
                     it.close()
                 break
@@ -1447,17 +1631,29 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             and (epoch + 1) % config.checkpoint_every == 0
             and not stop
         ):
-            # A max_steps stop mid-epoch must not checkpoint the partial
-            # epoch as completed — resume would silently skip its remainder.
-            ckpt.save(epoch + 1, state)
+            # Epoch-boundary checkpoint — step-id'd (absolute data step,
+            # monotonic across restarts) with a cursor naming the next
+            # epoch's first batch. A max_steps stop mid-epoch must not
+            # checkpoint the partial epoch as completed — resume would
+            # silently skip its remainder (preemptions go through the
+            # journal's emergency path instead).
+            journal.state = state
+            journal.rng = rng
+            journal.cursor_base = {"epoch": epoch + 1, "step": 0}
+            if ckpt.save(journal.abs_step, state,
+                         cursor=journal.make_cursor()):
+                journal.saved_step = journal.abs_step
         if stop:
             break
 
     results["history"] = history
     results["steps"] = global_step  # train steps executed this run
+    results["global_step"] = journal.abs_step  # absolute, across restarts
     results["total_time"] = time.perf_counter() - total_start
     results["start_epoch"] = start_epoch
-    if config.eval_at_end:
+    if journal.preempted:
+        results["preempted"] = True
+    if config.eval_at_end and not journal.preempted:
         # Final eval — over the val split when given, else over the train
         # loader as the reference does (lance_iterable.py:125-127); all
         # processes participate since eval is itself a sharded computation.

@@ -1,0 +1,181 @@
+"""Deterministic preemption injection for the TRAINER — ``fleet/chaos.py``'s
+training-side twin.
+
+The fleet proved its failover by scripting member death at an exact frame
+(``ChaosController.kill_after(n)`` fires synchronously in the sender path).
+The trainer's preemption path needs the same property: a chaos test that
+SIGKILLs "roughly mid-epoch" can never assert the resume point, so the kill
+is armed at an exact *completed step count* and fired synchronously from the
+step loop itself — the k-th completed step is the k-th hook call, regardless
+of thread scheduling or wall clocks.
+
+Three actions, mirroring the real failure shapes:
+
+* ``sigkill`` — the preemption-without-grace shape: ``os.kill(getpid(),
+  SIGKILL)`` after exactly N steps. No handler runs, no emergency
+  checkpoint: the restart must fall back to the newest intact periodic
+  checkpoint and prove the stream bit-identical from there.
+* ``sigterm`` — the orchestrated-preemption shape: SIGTERM to self. The
+  hook runs on the main thread, so CPython delivers the signal at the next
+  bytecode boundary — the ``PreemptionHandler`` flag is set before the loop
+  polls it, making the drain land after exactly N steps.
+* ``drain`` — the in-process twin of sigterm for tests that must not signal
+  the host process (pytest): calls the handler's ``request()`` directly.
+
+Armed via ``TrainerChaos.from_env()`` reading ``LDT_CHAOS`` (e.g.
+``sigkill@7``) so subprocess harnesses (``scripts/preempt_smoke.py``)
+script the run without new CLI surface, or programmatically in-process.
+
+:class:`StepTrace` is the proof instrument: when ``LDT_STEP_TRACE_PATH`` is
+set, the trainer appends one JSONL record per completed step — absolute
+step, epoch, a SHA-256 over the batch's host bytes, and the loss — so a
+killed-and-resumed run is compared to an uninterrupted control arm
+step-for-step. Hashing forces a per-step D2H fetch; the trace is a
+debug/CI instrument (single-host: it reads the addressable shards), never
+on in production runs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+__all__ = [
+    "TrainerChaos",
+    "StepTrace",
+    "batch_digest",
+    "read_trace",
+    "CHAOS_ENV",
+    "TRACE_ENV",
+]
+
+CHAOS_ENV = "LDT_CHAOS"
+TRACE_ENV = "LDT_STEP_TRACE_PATH"
+
+_ACTIONS = ("sigkill", "sigterm", "drain")
+
+
+class TrainerChaos:
+    """Scripted preemption of THIS training process after exactly
+    ``at_step`` completed steps. The trainer calls :meth:`on_step` with its
+    this-run completed-step count at each step boundary; the armed action
+    fires once, synchronously."""
+
+    def __init__(self, action: str, at_step: int):
+        if action not in _ACTIONS:
+            raise ValueError(
+                f"chaos action must be one of {_ACTIONS}, got {action!r}"
+            )
+        if at_step < 1:
+            raise ValueError(f"chaos step must be >= 1, got {at_step}")
+        self.action = action
+        self.at_step = int(at_step)
+        self.fired = threading.Event()
+        # Set by the trainer: the PreemptionHandler.request bound for the
+        # drain action (and the observable effect of sigterm).
+        self.drain_cb: Optional[Callable[[], None]] = None
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> Optional["TrainerChaos"]:
+        """Parse ``LDT_CHAOS=<action>@<step>``; ``None`` when unset. A
+        malformed spec raises — a chaos harness silently disarmed would
+        make the smoke pass vacuously."""
+        spec = (env if env is not None else os.environ).get(CHAOS_ENV)
+        if not spec:
+            return None
+        action, sep, step = spec.partition("@")
+        if not sep or not step.lstrip("-").isdigit():
+            raise ValueError(
+                f"{CHAOS_ENV}={spec!r}: expected '<action>@<step>', e.g. "
+                "'sigkill@7'"
+            )
+        return cls(action.strip().lower(), int(step))
+
+    def on_step(self, steps_completed: int) -> None:
+        """Step-boundary hook. Fires the armed action the first time
+        ``steps_completed`` reaches ``at_step``."""
+        if self.fired.is_set() or steps_completed < self.at_step:
+            return
+        self.fired.set()
+        if self.action == "sigkill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif self.action == "sigterm":
+            # Runs on the main thread: the handler executes at the next
+            # bytecode boundary, before the loop's preemption poll.
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif self.drain_cb is not None:
+            self.drain_cb()
+
+
+def batch_digest(batch) -> str:
+    """SHA-256 over a batch pytree's host bytes, key-ordered — the
+    bit-identity fingerprint chaos tests compare across runs. Device arrays
+    are fetched (single-host: every shard is addressable); dict key order
+    is canonicalised so producer-side reordering can't alias."""
+    h = hashlib.sha256()
+    if isinstance(batch, dict):
+        items = sorted(batch.items())
+    else:
+        items = [("", batch)]
+    for key, leaf in items:
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        h.update(key.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+class StepTrace:
+    """Append-only JSONL of per-step training facts for resume-fidelity
+    proofs: ``{"step", "epoch", "batch_sha256", "loss"}`` per completed
+    step, flushed line-by-line so a SIGKILL loses at most the in-flight
+    record. Appending is crash-safe by construction (O_APPEND line writes),
+    which is why this file is exempt from the LDT901 tempfile+replace
+    discipline that applies to state the restart *trusts*."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "a", encoding="utf-8")
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> Optional["StepTrace"]:
+        path = (env if env is not None else os.environ).get(TRACE_ENV)
+        return cls(path) if path else None
+
+    def record(self, step: int, epoch: int, batch, loss) -> None:
+        self._f.write(json.dumps({
+            "step": int(step),
+            "epoch": int(epoch),
+            "batch_sha256": batch_digest(batch),
+            "loss": float(loss),
+        }) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def read_trace(path: str) -> list:
+    """Parsed records of a :class:`StepTrace` file; a torn final line (the
+    SIGKILL window) is dropped, matching its at-most-one-record loss
+    contract."""
+    out = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out

@@ -1,32 +1,125 @@
-"""Checkpoint / resume — orbax-backed train-state persistence.
+"""Checkpoint / resume — crash-consistent, step-granular, cursor-carrying.
 
 The reference has NO checkpointing (SURVEY.md §5: grep finds no
 save/load/state_dict; every run restarts from torchvision pretrained
-weights). Added here because on TPU pods preemption is routine and the
-launcher-level restart the reference relies on
-(``torch.distributed.elastic``, reference ``README.md:222-251``) needs
-something to restore. Multi-host-safe: orbax writes sharded arrays from
-every process and restores them onto the current mesh's shardings.
+weights). Through r7 this module was a thin orbax wrapper saving model +
+optimizer state at epoch granularity — which on a preemptible TPU pod means
+a SIGKILL mid-epoch replays or skips up to an epoch of data on restart,
+exactly the reproducibility failure the distributed-pipelines paper
+(PAPERS.md, arxiv 2604.21275) calls out. r8 makes the checkpoint the unit
+of *crash consistency* for the whole training position:
+
+* **model + optimizer state** — orbax, sharded writes from every process,
+  restored onto the live mesh's shardings (unchanged);
+* **data-plane cursor** — the loader ``state_dict()`` (epoch + batches
+  consumed; see ``data/pipeline.py`` for the contract all five loaders
+  implement) plus host RNG key and step counters, persisted as a small
+  JSON sidecar *per step*;
+* **content-hashed manifest** — the sidecar embeds the SHA-256 of its own
+  canonical payload, written atomically (``tempfile`` + ``os.replace``, the
+  LDT901 discipline), and a step is *intact* only when orbax committed it
+  AND the sidecar verifies. :meth:`restore_latest` walks steps newest-first
+  and falls back past corrupt/partial ones instead of crashing — a torn
+  write from the previous preemption must never brick the restart.
+
+Write ordering is the crash-consistency argument: the sidecar commits
+(atomic rename) BEFORE the orbax save is even dispatched, and orbax itself
+only registers a step after its own atomic finalize. So a crash at any
+point leaves either (a) no trace of the step, (b) a sidecar with no orbax
+step — invisible to :meth:`restore_latest`, garbage-collected on the next
+save — or (c) a fully intact pair. There is no window where a restart can
+pair the new model state with a stale cursor or vice versa.
+
+Telemetry: ``ckpt_save_ms`` histogram (save dispatch, + commit wait when
+``wait=True``), ``ckpt_last_success_step`` gauge — both on the process
+registry, scraped at /metrics next to the trainer series.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Optional
+import tempfile
+import time
+from typing import Any, Optional, Tuple
 
 import jax
 
-__all__ = ["CheckpointManager"]
+from ..obs.registry import MetricsRegistry, default_registry
+
+__all__ = [
+    "CheckpointManager",
+    "atomic_write_json",
+    "read_verified_json",
+    "pack_rng_key",
+    "unpack_rng_key",
+]
+
+_CURSOR_DIR = "cursors"
+
+
+def _canonical(payload: dict) -> bytes:
+    return json.dumps(payload, sort_keys=True, separators=(",", ":")).encode()
+
+
+def atomic_write_json(path: str, payload: dict) -> None:
+    """Crash-consistent JSON write: content-hashed manifest, tempfile +
+    ``os.replace``. A reader either sees the complete verified document or
+    the previous one — never a torn write (the LDT901 contract)."""
+    doc = {
+        "version": 1,
+        "sha256": hashlib.sha256(_canonical(payload)).hexdigest(),
+        "payload": payload,
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path) or ".", prefix=".tmp-manifest-"
+    )
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def read_verified_json(path: str) -> Optional[dict]:
+    """The payload of :func:`atomic_write_json`, or ``None`` when the file
+    is absent, unparseable, or fails its content hash — corruption reads as
+    "not there", never as an exception a restart would die on."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    payload = doc.get("payload")
+    digest = doc.get("sha256")
+    if not isinstance(payload, dict) or not isinstance(digest, str):
+        return None
+    if hashlib.sha256(_canonical(payload)).hexdigest() != digest:
+        return None
+    return payload
 
 
 class CheckpointManager:
-    """Thin orbax wrapper: ``save(step, state)`` / ``restore(state) -> state``.
+    """Orbax-backed train-state persistence + crash-consistent cursors.
 
-    ``restore`` takes the freshly-initialised state as the target so dtypes,
-    shapes, and shardings come from the live mesh, not the checkpoint.
+    ``save(step, state)`` / ``restore(state) -> state`` keep their original
+    shapes (existing callers and tests unchanged); ``save(..., cursor=...)``
+    additionally persists the data-plane position, and
+    :meth:`restore_latest` returns ``(state, cursor, step)`` from the newest
+    *intact* checkpoint, skipping corrupt/partial ones.
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 registry: Optional[MetricsRegistry] = None):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
@@ -37,18 +130,124 @@ class CheckpointManager:
                 max_to_keep=max_to_keep, create=True
             ),
         )
+        self.registry = (
+            registry if registry is not None else default_registry()
+        )
+        self._save_hist = self.registry.histogram("ckpt_save_ms")
+        self._last_gauge = self.registry.gauge("ckpt_last_success_step")
+        # Steps proven unrestorable THIS process (orbax payload torn in a
+        # way only an actual restore detects). A fallback rerun revisits
+        # these ids; save() must treat them as stale occupants, never as
+        # already-persisted progress.
+        self._poisoned: set = set()
 
-    def save(self, step: int, state: Any, wait: bool = False) -> None:
+    # -- cursor sidecars ----------------------------------------------------
+
+    def _cursor_path(self, step: int) -> str:
+        return os.path.join(self.directory, _CURSOR_DIR, f"{int(step)}.json")
+
+    def cursor(self, step: int) -> Optional[dict]:
+        """The verified cursor payload saved with ``step``, or ``None``
+        (legacy epoch-granular checkpoints have none; a corrupt sidecar
+        reads as none-AND-not-intact, see :meth:`step_intact`)."""
+        return read_verified_json(self._cursor_path(step))
+
+    def step_intact(self, step: int) -> bool:
+        """True when ``step`` is safe to restore: orbax committed it and its
+        cursor sidecar (when one exists) passes the content hash. A sidecar
+        file that exists but fails verification marks the whole step corrupt
+        — the cursor and the model state must never be un-paired."""
+        if step in self._poisoned:
+            return False
+        if step not in self.manager.all_steps():
+            return False
+        path = self._cursor_path(step)
+        if not os.path.exists(path):
+            return True  # legacy model-only checkpoint: intact, cursorless
+        return read_verified_json(path) is not None
+
+    def _gc_cursors(self) -> None:
+        """Drop sidecars whose orbax step was garbage-collected
+        (max_to_keep) or never committed (crash between sidecar write and
+        orbax finalize)."""
+        cursor_dir = os.path.join(self.directory, _CURSOR_DIR)
+        try:
+            entries = sorted(os.listdir(cursor_dir))
+        except OSError:
+            return
+        live = set(self.manager.all_steps())
+        for name in entries:
+            stem, ext = os.path.splitext(name)
+            if ext != ".json" or not stem.isdigit():
+                continue
+            if int(stem) not in live:
+                try:
+                    os.unlink(os.path.join(cursor_dir, name))
+                except OSError:
+                    pass
+
+    # -- save/restore -------------------------------------------------------
+
+    def save(self, step: int, state: Any, wait: bool = False,
+             cursor: Optional[dict] = None) -> bool:
+        """Persist ``state`` (and ``cursor``) under ``step``. Returns False
+        when an INTACT checkpoint already holds the step (an emergency save
+        racing a periodic one must not raise — and on a deterministic
+        trajectory the existing content is equivalent). A stale NON-intact
+        occupant is deleted and overwritten (raising when it cannot be
+        cleared): after a fallback restore the rerun revisits the corrupt
+        step's id, and silently skipping it there would lose the emergency
+        checkpoint while exiting 0. ``wait=True`` blocks until the orbax
+        commit is durable — required before process exit (emergency
+        checkpoints)."""
+        step = int(step)
+        if step in self.manager.all_steps():
+            if self.step_intact(step):
+                return False
+            try:
+                self.manager.delete(step)
+            except Exception as exc:  # noqa: BLE001
+                # Loud, not False: a benign-looking skip here would let a
+                # SIGTERM drain exit 0 having persisted nothing — the
+                # caller must see that the step could not be cleared.
+                raise RuntimeError(
+                    f"cannot clear stale checkpoint step {step}: {exc}"
+                ) from exc
+            try:
+                os.unlink(self._cursor_path(step))
+            except OSError:
+                pass
+        t0 = time.monotonic()
+        if cursor is not None:
+            # Sidecar FIRST: if we crash before the orbax commit, the step
+            # never appears in all_steps and the orphan sidecar is GC'd; the
+            # reverse order could commit model state with no cursor.
+            atomic_write_json(self._cursor_path(step), cursor)
         self.manager.save(
             step, args=self._ocp.args.StandardSave(state)
         )
         if wait:
             self.manager.wait_until_finished()
+        self._save_hist.observe((time.monotonic() - t0) * 1e3)
+        self._last_gauge.set(step)
+        self._poisoned.discard(step)  # the id now holds fresh content
+        self._gc_cursors()
+        return True
 
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
 
+    def latest_intact_step(self) -> Optional[int]:
+        """Newest step whose orbax dir is committed and whose cursor (when
+        present) verifies — the restore candidate order."""
+        for step in sorted(self.manager.all_steps(), reverse=True):
+            if self.step_intact(step):
+                return step
+        return None
+
     def restore(self, target_state: Any, step: Optional[int] = None) -> Any:
+        """Original restore shape: latest (or given) step's state, the
+        fresh ``target_state`` when the directory is empty."""
         step = self.latest_step() if step is None else step
         if step is None:
             return target_state
@@ -57,6 +256,54 @@ class CheckpointManager:
         )
         return restored
 
+    def restore_latest(
+        self, target_state: Any
+    ) -> Optional[Tuple[Any, Optional[dict], int]]:
+        """``(state, cursor, step)`` from the newest intact checkpoint.
+
+        Walks steps newest-first; a step that fails intactness OR whose
+        orbax restore raises (truncated array files from a crash mid-write)
+        is skipped in favor of the previous one — a damaged latest
+        checkpoint degrades resume granularity, never the restart itself.
+        Returns ``None`` when no step restores (fresh start).
+        """
+        for step in sorted(self.manager.all_steps(), reverse=True):
+            if not self.step_intact(step):
+                continue
+            try:
+                state = self.manager.restore(
+                    step, args=self._ocp.args.StandardRestore(target_state)
+                )
+            except Exception:  # noqa: BLE001 — any torn step must fall back
+                # Poison the id: intactness checks cannot see a torn orbax
+                # payload, and the rerun will revisit this step — save()
+                # must overwrite it, not mistake it for persisted progress.
+                self._poisoned.add(int(step))
+                continue
+            return state, self.cursor(step), int(step)
+        return None
+
     def close(self) -> None:
         self.manager.wait_until_finished()
         self.manager.close()
+
+
+def pack_rng_key(key: jax.Array) -> list:
+    """JSON-portable form of a scalar host PRNG key: the ``key_data`` words
+    as a flat int list (threefry: 2 × uint32; rbg: 4). The checkpoint cursor
+    carries this so a resumed run continues the exact per-step rng stream —
+    the split sequence, and with it augmentation/MLM-masking draws, matches
+    the uninterrupted run bit for bit."""
+    import numpy as np
+
+    return np.asarray(jax.random.key_data(key), np.uint32).ravel().tolist()
+
+
+def unpack_rng_key(packed) -> jax.Array:
+    """Rebuild the scalar PRNG key from :func:`pack_rng_key` output."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    return jax.random.wrap_key_data(
+        jnp.asarray(np.asarray(packed, dtype=np.uint32))
+    )

@@ -1,4 +1,4 @@
-"""SIGTERM → graceful stop, for the long-running serve entrypoints.
+"""SIGTERM → graceful stop, for the long-running entrypoints.
 
 ``docker stop`` / k8s preemption deliver SIGTERM, not KeyboardInterrupt —
 before this helper the serve loops only caught the latter, so an
@@ -7,6 +7,14 @@ flush (and, worse, the worker-pool teardown that reaps ``/dev/shm``
 segments). The handler only sets a stop event: all real teardown stays in
 the serve loop's ``finally`` (signal handlers must not join threads or
 close sockets mid-interpreter-instruction).
+
+r8 adds the *trainer* half: :class:`PreemptionHandler` gives ``train()``
+the same discipline — SIGTERM sets a flag the step loop polls at step
+boundaries, so the in-flight step finishes, an emergency checkpoint is
+taken (awaited), the placement ring drains, and the process exits 0. The
+handler counts ``trainer_preemptions_total`` on the registry and restores
+the previous signal disposition on uninstall (a train() inside pytest or a
+notebook must not permanently hijack SIGTERM).
 """
 
 from __future__ import annotations
@@ -14,7 +22,76 @@ from __future__ import annotations
 import threading
 from typing import Callable
 
-__all__ = ["install_sigterm_handler"]
+__all__ = ["install_sigterm_handler", "PreemptionHandler"]
+
+
+class PreemptionHandler:
+    """SIGTERM → ``requested`` flag + ``trainer_preemptions_total`` counter.
+
+    Usage::
+
+        preempt = PreemptionHandler().install()
+        try:
+            ...  # poll preempt.requested at step boundaries
+        finally:
+            preempt.uninstall()
+
+    ``install`` is a no-op off the main thread or where SIGTERM does not
+    exist (``installed`` stays False) — the run then simply has no graceful
+    preemption path, same as before. ``request()`` triggers the identical
+    drain in-process (the deterministic chaos hook, and tests that must not
+    signal the pytest process).
+    """
+
+    def __init__(self, registry=None):
+        from ..obs.registry import default_registry
+
+        self._event = threading.Event()
+        self._counter = (
+            registry if registry is not None else default_registry()
+        ).counter("trainer_preemptions_total")
+        self._previous = None
+        self.installed = False
+
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def request(self) -> None:
+        """Trigger the drain without a signal (idempotent; counted once)."""
+        if not self._event.is_set():
+            self._event.set()
+            self._counter.inc()
+
+    def install(self) -> "PreemptionHandler":
+        if self.installed:
+            return self
+        try:
+            import signal
+
+            if threading.current_thread() is not threading.main_thread():
+                return self
+
+            def _handler(signum, frame):  # noqa: ARG001 — signal signature
+                self.request()
+
+            self._previous = signal.signal(signal.SIGTERM, _handler)
+            self.installed = True
+        except (ValueError, OSError, AttributeError):
+            self.installed = False
+        return self
+
+    def uninstall(self) -> None:
+        """Restore the previous SIGTERM disposition (idempotent)."""
+        if not self.installed:
+            return
+        try:
+            import signal
+
+            signal.signal(signal.SIGTERM, self._previous or signal.SIG_DFL)
+        except (ValueError, OSError, AttributeError):
+            pass
+        self.installed = False
 
 
 def install_sigterm_handler(callback: Callable[[], None]) -> bool:

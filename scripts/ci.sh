@@ -22,7 +22,14 @@
 # shape/sharding through the async placement plane (bit-identical to the
 # sync control arm), and trainer_h2d_ms / placement_buffer_depth on
 # /metrics.
-# Stage 6 — the tier-1 verify command from ROADMAP.md, verbatim.
+# Stage 6 — preemption smoke (scripts/preempt_smoke.py): a real trainer
+# subprocess SIGKILLed after exactly N steps (deterministic chaos,
+# LDT_CHAOS=sigkill@N) restarts from the newest intact step checkpoint and
+# replays the exact remaining batch stream — per-step batch hashes AND
+# losses equal to an uninterrupted control arm; a second trainer SIGTERMed
+# mid-epoch drains with an awaited emergency checkpoint and exit 0 while
+# its /metrics serves the ckpt_* series.
+# Stage 7 — the tier-1 verify command from ROADMAP.md, verbatim.
 set -e
 cd "$(dirname "$0")/.."
 
@@ -104,6 +111,12 @@ echo "== placement smoke (mesh-native global batches + H2D telemetry) =="
 # _bench_init.force_cpu XLA_FLAGS fallback), placed-vs-sync bit parity,
 # and the trainer_h2d_ms series scraped from a live /metrics.
 timeout -k 10 300 env PYTHONPATH=. python scripts/placement_smoke.py
+
+echo "== preemption smoke (SIGKILL resume fidelity + SIGTERM drain) =="
+# Real subprocess trainers: the SIGKILL is genuine process death mid-epoch
+# (no handler runs — the crash-consistency manifest must carry recovery),
+# and the SIGTERM is the real k8s-eviction path asserted to exit 0.
+timeout -k 10 540 env JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/preempt_smoke.py
 
 echo "== tier-1 tests =="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -932,6 +932,80 @@ def test_list_rules_covers_registry(capsys):
     assert len(all_rules()) >= 8
 
 
+# -- LDT901 crash-consistent state writes ------------------------------------
+
+
+def test_ldt901_flags_inplace_state_write(tmp_path):
+    findings = run_rules(tmp_path, {"ckpt.py": """\
+        import json
+
+        def save(path, payload):
+            with open(path, "w") as f:
+                json.dump(payload, f)
+    """}, state_paths=["ckpt.py"])
+    assert "LDT901" in rule_ids(findings)
+    assert "os.replace" in findings[0].message
+
+
+def test_ldt901_flags_path_write_text(tmp_path):
+    findings = run_rules(tmp_path, {"ckpt.py": """\
+        from pathlib import Path
+
+        def save(path, payload):
+            Path(path).write_text(payload)
+    """}, state_paths=["ckpt.py"])
+    assert "LDT901" in rule_ids(findings)
+
+
+def test_ldt901_tempfile_replace_pattern_clean(tmp_path):
+    findings = run_rules(tmp_path, {"ckpt.py": """\
+        import json
+        import os
+        import tempfile
+
+        def save(path, payload):
+            fd, tmp = tempfile.mkstemp(dir=".")
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+    """}, state_paths=["ckpt.py"])
+    assert [f for f in findings if f.rule == "LDT901"] == []
+
+
+def test_ldt901_append_and_read_modes_exempt(tmp_path):
+    findings = run_rules(tmp_path, {"ckpt.py": """\
+        def log(path, line):
+            with open(path, "a") as f:
+                f.write(line)
+
+        def load(path):
+            with open(path) as f:
+                return f.read()
+    """}, state_paths=["ckpt.py"])
+    assert [f for f in findings if f.rule == "LDT901"] == []
+
+
+def test_ldt901_only_in_state_paths(tmp_path):
+    findings = run_rules(tmp_path, {"other.py": """\
+        def save(path, payload):
+            with open(path, "w") as f:
+                f.write(payload)
+    """}, state_paths=["ckpt.py"])
+    assert [f for f in findings if f.rule == "LDT901"] == []
+
+
+def test_ldt901_repo_state_modules_clean():
+    """checkpoint.py and the baseline writer persist state atomically —
+    zero LDT901 findings on the repo's own configured state-paths."""
+    from lance_distributed_training_tpu.analysis.config import load_config
+    from lance_distributed_training_tpu.analysis.core import analyze_project
+
+    root = str(REPO_ROOT)
+    config = load_config(root)
+    findings, _, _ = analyze_project(root, config)
+    assert [f.location() for f in findings if f.rule == "LDT901"] == []
+
+
 # -- self-check ---------------------------------------------------------------
 
 

@@ -1,0 +1,599 @@
+"""Preemption tolerance: crash-consistent checkpoints + data-plane cursors.
+
+Fast tier: CheckpointManager intactness/fallback semantics, the loader
+``state_dict()/load_state_dict()`` cursor contract on all five loaders (+
+``PlacedLoader``), the retry policy, and the preemption signal plumbing.
+The slow tier proves end-to-end resume fidelity: a run drained mid-epoch
+and restarted from its emergency checkpoint consumes the exact remaining
+batch sequence with a loss trajectory matching an uninterrupted control
+arm step-for-step (the subprocess SIGKILL twin lives in
+``scripts/preempt_smoke.py``, pinned by CI).
+"""
+
+import glob
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from lance_distributed_training_tpu.data import ImageClassificationDecoder
+from lance_distributed_training_tpu.data.pipeline import (
+    MapStylePipeline,
+    make_train_pipeline,
+)
+from lance_distributed_training_tpu.data.samplers import slice_plan
+from lance_distributed_training_tpu.utils.checkpoint import (
+    CheckpointManager,
+    atomic_write_json,
+    pack_rng_key,
+    read_verified_json,
+    unpack_rng_key,
+)
+
+
+def _state(seed=0):
+    gen = np.random.default_rng(seed)
+    return {"w": gen.random((4, 3)).astype(np.float32),
+            "b": gen.random(3).astype(np.float32)}
+
+
+def _zeros():
+    return {"w": np.zeros((4, 3), np.float32), "b": np.zeros(3, np.float32)}
+
+
+def _corrupt_step_dir(directory, step):
+    """Truncate every payload file under the orbax step dir — the torn
+    write a SIGKILL mid-commit leaves behind."""
+    for p in glob.glob(os.path.join(directory, str(step), "**"),
+                       recursive=True):
+        if os.path.isfile(p):
+            with open(p, "wb") as f:  # ldt: ignore[LDT901] — test corruption
+                f.write(b"torn")
+
+
+# -- manifest primitives -----------------------------------------------------
+
+
+def test_atomic_json_roundtrip_and_torn_write(tmp_path):
+    path = str(tmp_path / "m.json")
+    atomic_write_json(path, {"epoch": 2, "step": 7})
+    assert read_verified_json(path) == {"epoch": 2, "step": 7}
+    # Torn/garbled content reads as absent, never as an exception.
+    with open(path, "a", encoding="utf-8") as f:
+        f.write("garbage")
+    assert read_verified_json(path) is None
+    assert read_verified_json(str(tmp_path / "missing.json")) is None
+
+
+def test_manifest_hash_rejects_tampered_payload(tmp_path):
+    import json
+
+    path = str(tmp_path / "m.json")
+    atomic_write_json(path, {"step": 7})
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    doc["payload"]["step"] = 8  # flip without re-hashing
+    with open(path, "w", encoding="utf-8") as f:  # ldt: ignore[LDT901]
+        json.dump(doc, f)
+    assert read_verified_json(path) is None
+
+
+def test_rng_key_pack_roundtrip():
+    key = jax.random.key(123)
+    restored = unpack_rng_key(pack_rng_key(key))
+    np.testing.assert_array_equal(
+        jax.random.key_data(restored), jax.random.key_data(key)
+    )
+    # The restored key continues the identical split stream.
+    a = jax.random.key_data(jax.random.split(key)[0])
+    b = jax.random.key_data(jax.random.split(restored)[0])
+    np.testing.assert_array_equal(a, b)
+
+
+# -- CheckpointManager -------------------------------------------------------
+
+
+def test_restore_from_empty_dir(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    assert mgr.latest_step() is None
+    assert mgr.latest_intact_step() is None
+    assert mgr.restore_latest(_zeros()) is None
+    fresh = _zeros()
+    assert mgr.restore(fresh) is fresh  # original shape: target unchanged
+    mgr.close()
+
+
+def test_latest_step_numeric_ordering(tmp_path):
+    """Step 10 must beat step 2 — numeric, not lexicographic ("10" < "2")."""
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=5)
+    mgr.save(2, _state(2), wait=True, cursor={"epoch": 0, "step": 2})
+    mgr.save(10, _state(10), wait=True, cursor={"epoch": 0, "step": 10})
+    assert mgr.latest_step() == 10
+    assert mgr.latest_intact_step() == 10
+    _, cursor, step = mgr.restore_latest(_zeros())
+    assert step == 10 and cursor["step"] == 10
+    mgr.close()
+
+
+def test_duplicate_step_save_skipped(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    assert mgr.save(5, _state(), wait=True, cursor={"epoch": 0, "step": 5})
+    # An emergency save racing the periodic one must not raise.
+    assert mgr.save(5, _state(1), wait=True) is False
+    mgr.close()
+
+
+def test_corrupt_step_falls_back_to_previous_intact(tmp_path):
+    directory = str(tmp_path / "ck")
+    mgr = CheckpointManager(directory, max_to_keep=5)
+    good = _state(1)
+    mgr.save(3, good, wait=True, cursor={"epoch": 0, "step": 3})
+    mgr.save(6, _state(2), wait=True, cursor={"epoch": 0, "step": 6})
+    _corrupt_step_dir(directory, 6)
+    state, cursor, step = mgr.restore_latest(_zeros())
+    assert step == 3 and cursor["step"] == 3
+    np.testing.assert_array_equal(state["w"], good["w"])
+    mgr.close()
+
+
+def test_corrupt_cursor_sidecar_skips_step(tmp_path):
+    """A sidecar failing its content hash marks the WHOLE step corrupt —
+    model state and cursor must never be un-paired."""
+    directory = str(tmp_path / "ck")
+    mgr = CheckpointManager(directory, max_to_keep=5)
+    good = _state(1)
+    mgr.save(3, good, wait=True, cursor={"epoch": 0, "step": 3})
+    mgr.save(6, _state(2), wait=True, cursor={"epoch": 0, "step": 6})
+    with open(os.path.join(directory, "cursors", "6.json"), "a",
+              encoding="utf-8") as f:
+        f.write("x")
+    assert not mgr.step_intact(6)
+    assert mgr.latest_intact_step() == 3
+    state, cursor, step = mgr.restore_latest(_zeros())
+    assert step == 3 and cursor["step"] == 3
+    np.testing.assert_array_equal(state["w"], good["w"])
+    mgr.close()
+
+
+def test_save_overwrites_stale_corrupt_step(tmp_path):
+    """After a fallback restore the rerun revisits the corrupt step's id;
+    the emergency save there must REPLACE the stale occupant — silently
+    skipping would exit 0 having persisted nothing. A torn orbax payload
+    is only detectable by the restore itself, so the failed restore
+    poisons the id for save()."""
+    directory = str(tmp_path / "ck")
+    mgr = CheckpointManager(directory, max_to_keep=5)
+    mgr.save(3, _state(1), wait=True, cursor={"epoch": 0, "step": 3})
+    mgr.save(6, _state(2), wait=True, cursor={"epoch": 0, "step": 6})
+    _corrupt_step_dir(directory, 6)
+    _, _, step = mgr.restore_latest(_zeros())
+    assert step == 3  # fell back past the torn step 6, poisoning its id
+    assert not mgr.step_intact(6)
+    fresh = _state(9)
+    assert mgr.save(6, fresh, wait=True,
+                    cursor={"epoch": 0, "step": 6, "global_step": 6})
+    state, cursor, step = mgr.restore_latest(_zeros())
+    assert step == 6 and cursor["global_step"] == 6
+    np.testing.assert_array_equal(state["w"], fresh["w"])
+    mgr.close()
+
+
+def test_legacy_cursorless_step_restores_model_only(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    good = _state(4)
+    mgr.save(2, good, wait=True)  # pre-r8 shape: no cursor
+    assert mgr.step_intact(2)
+    state, cursor, step = mgr.restore_latest(_zeros())
+    assert step == 2 and cursor is None
+    np.testing.assert_array_equal(state["w"], good["w"])
+    mgr.close()
+
+
+def test_orphan_cursor_sidecars_gc(tmp_path):
+    directory = str(tmp_path / "ck")
+    mgr = CheckpointManager(directory, max_to_keep=2)
+    for step in (1, 2, 3):
+        mgr.save(step, _state(step), wait=True,
+                 cursor={"epoch": 0, "step": step})
+    assert set(mgr.manager.all_steps()) == {2, 3}
+    names = sorted(os.listdir(os.path.join(directory, "cursors")))
+    assert names == ["2.json", "3.json"], names  # step 1's sidecar reaped
+    mgr.close()
+
+
+def test_ckpt_metrics_recorded(tmp_path):
+    from lance_distributed_training_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    mgr = CheckpointManager(str(tmp_path / "ck"), registry=reg)
+    mgr.save(7, _state(), wait=True, cursor={"epoch": 0, "step": 7})
+    snap = reg.render_prometheus()
+    assert "ckpt_save_ms_count 1" in snap
+    assert "ckpt_last_success_step 7" in snap
+    mgr.close()
+
+
+# -- loader cursor contract --------------------------------------------------
+
+
+def _decoder():
+    return ImageClassificationDecoder(image_size=32)
+
+
+def _assert_tail_identical(tail, full, start):
+    assert len(tail) == len(full) - start, (len(tail), len(full), start)
+    for i, (a, b) in enumerate(zip(tail, full[start:])):
+        np.testing.assert_array_equal(
+            np.asarray(a["image"]), np.asarray(b["image"]),
+            err_msg=f"step {start + i}")
+        np.testing.assert_array_equal(
+            np.asarray(a["label"]), np.asarray(b["label"]),
+            err_msg=f"step {start + i}")
+
+
+def test_slice_plan_bounds():
+    plan = [1, 2, 3]
+    assert slice_plan(plan, 0) == [1, 2, 3]
+    assert slice_plan(plan, 3) == []  # checkpoint on the last batch
+    with pytest.raises(ValueError, match="outside plan"):
+        slice_plan(plan, 4)
+    with pytest.raises(ValueError, match="outside plan"):
+        slice_plan(plan, -1)
+
+
+def test_datapipeline_cursor_resume_bit_identical(image_dataset):
+    full = list(make_train_pipeline(image_dataset, "batch", 16, 0, 1,
+                                    _decoder()))
+    loader = make_train_pipeline(image_dataset, "batch", 16, 0, 1,
+                                 _decoder())
+    it = iter(loader)
+    for _ in range(5):
+        next(it)
+    sd = loader.state_dict()
+    assert sd["step"] == 5  # batches handed out == batches consumed here
+    it.close()
+    resumed = make_train_pipeline(image_dataset, "batch", 16, 0, 1,
+                                  _decoder())
+    resumed.load_state_dict(sd)
+    _assert_tail_identical(list(resumed), full, 5)
+
+
+def test_datapipeline_cursor_multi_producer(image_dataset):
+    full = list(make_train_pipeline(image_dataset, "batch", 16, 0, 1,
+                                    _decoder(), producers=3))
+    resumed = make_train_pipeline(image_dataset, "batch", 16, 0, 1,
+                                  _decoder(), producers=3)
+    resumed.load_state_dict({"step": 7})
+    _assert_tail_identical(list(resumed), full, 7)
+
+
+def test_map_style_cursor_epoch_and_step(image_dataset):
+    full = list(MapStylePipeline(image_dataset, 16, 0, 1, _decoder(),
+                                 seed=3, epoch=2))
+    resumed = MapStylePipeline(image_dataset, 16, 0, 1, _decoder(),
+                               seed=3, epoch=0)
+    resumed.load_state_dict({"epoch": 2, "step": 4})
+    assert resumed.epoch == 2
+    _assert_tail_identical(list(resumed), full, 4)
+    # Consuming to the end leaves the cursor at the plan length.
+    assert resumed.state_dict() == {"epoch": 2, "step": len(full)}
+
+
+def test_set_epoch_resets_cursor(image_dataset):
+    loader = MapStylePipeline(image_dataset, 16, 0, 1, _decoder(), seed=1)
+    loader.load_state_dict({"epoch": 0, "step": 9})
+    loader.set_epoch(1)
+    assert loader.state_dict() == {"epoch": 1, "step": 0}
+
+
+def test_folder_pipeline_cursor(tmp_path):
+    from PIL import Image
+
+    from lance_distributed_training_tpu.data.folder import FolderDataPipeline
+    from tests.conftest import make_jpeg
+
+    gen = np.random.default_rng(0)
+    root = tmp_path / "folder"
+    for cls in ("a", "b"):
+        (root / cls).mkdir(parents=True)
+        for i in range(24):
+            (root / cls / f"{i}.jpg").write_bytes(make_jpeg(gen, 32))
+
+    def build():
+        return FolderDataPipeline(str(root), 8, 0, 1, _decoder(),
+                                  loader_style="map", seed=2, epoch=1)
+
+    full = list(build())
+    resumed = FolderDataPipeline(str(root), 8, 0, 1, _decoder(),
+                                 loader_style="map", seed=2, epoch=0)
+    resumed.load_state_dict({"epoch": 1, "step": 2})
+    _assert_tail_identical(list(resumed), full, 2)
+
+
+def test_remote_loader_cursor(image_dataset):
+    from lance_distributed_training_tpu.service import (
+        DataService,
+        RemoteLoader,
+        ServeConfig,
+    )
+
+    svc = DataService(ServeConfig(
+        dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+        image_size=32, queue_depth=2,
+    )).start()
+    try:
+        def loader():
+            return RemoteLoader(f"127.0.0.1:{svc.port}", 16, 0, 1,
+                                connect_retries=2, backoff_s=0.01)
+
+        full = list(loader())
+        partial = loader()
+        it = iter(partial)
+        for _ in range(6):
+            next(it)
+        sd = partial.state_dict()
+        assert sd == {"epoch": 0, "step": 6}
+        it.close()
+        resumed = loader()
+        resumed.load_state_dict(sd)
+        _assert_tail_identical(list(resumed), full, 6)
+    finally:
+        svc.stop()
+
+
+def test_fleet_loader_cursor(image_dataset):
+    from lance_distributed_training_tpu.fleet import (
+        Coordinator,
+        CoordinatorConfig,
+        FleetLoader,
+    )
+    from lance_distributed_training_tpu.service import DataService, ServeConfig
+
+    coord = Coordinator(CoordinatorConfig(
+        host="127.0.0.1", port=0,
+        heartbeat_interval_s=0.1, lease_ttl_s=0.6,
+    )).start()
+    servers = []
+    try:
+        for _ in range(2):
+            svc = DataService(ServeConfig(
+                dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+                image_size=32, queue_depth=2,
+                coordinator_addr=f"127.0.0.1:{coord.port}",
+            )).start()
+            assert svc.fleet_agent.registered.wait(5)
+            servers.append(svc)
+
+        def loader():
+            return FleetLoader(f"127.0.0.1:{coord.port}", 16, 0, 1,
+                               connect_retries=2, resolve_retries=3,
+                               backoff_s=0.05)
+
+        full = list(loader())
+        resumed = loader()
+        resumed.load_state_dict({"epoch": 0, "step": 5})
+        tail = list(resumed)
+        _assert_tail_identical(tail, full, 5)
+        assert resumed.state_dict() == {"epoch": 0, "step": len(full)}
+    finally:
+        for s in servers:
+            s.stop()
+        coord.stop()
+
+
+def test_placed_loader_cursor_counts_consumed_not_prefetched(image_dataset):
+    """The placement thread runs the inner pipeline AHEAD of the trainer;
+    the cursor must count batches the consumer took, not what the ring
+    decoded — else resume would skip the in-flight double-buffer."""
+    from lance_distributed_training_tpu.data.placement import PlacementPlane
+    from lance_distributed_training_tpu.parallel import get_mesh
+
+    mesh = get_mesh(jax.devices())
+
+    def build():
+        return make_train_pipeline(image_dataset, "batch", 16, 0, 1,
+                                   _decoder())
+
+    plane = PlacementPlane(mesh, depth=2)
+    placed = plane.wrap(build())
+    full = [
+        {k: np.asarray(v) for k, v in b.items()}
+        for b in plane.wrap(build())
+    ]
+    it = iter(placed)
+    for _ in range(3):
+        next(it)
+    sd = placed.state_dict()
+    assert sd["step"] == 3  # NOT 3 + ring depth
+    it.close()
+    resumed = plane.wrap(build())
+    resumed.load_state_dict(sd)
+    tail = [{k: np.asarray(v) for k, v in b.items()} for b in resumed]
+    _assert_tail_identical(tail, full, 3)
+    assert resumed.state_dict()["step"] == len(full)
+
+
+# -- retry policy ------------------------------------------------------------
+
+
+def test_retrying_attempts_and_counter():
+    from lance_distributed_training_tpu.obs.registry import MetricsRegistry
+    from lance_distributed_training_tpu.utils.retry import (
+        RetryPolicy,
+        retrying,
+    )
+
+    reg = MetricsRegistry()
+    seen = list(retrying(
+        RetryPolicy(attempts=3, base_s=0.0, jitter=False), registry=reg
+    ))
+    assert seen == [0, 1, 2]
+    assert "retry_attempts_total 2" in reg.render_prometheus()  # retries, not tries
+
+
+def test_retrying_full_jitter_bounded():
+    from lance_distributed_training_tpu.utils.retry import RetryPolicy
+
+    policy = RetryPolicy(attempts=5, base_s=0.2, cap_s=1.0)
+    for k in range(8):
+        assert policy.backoff_bound_s(k) <= 1.0
+    assert policy.backoff_bound_s(0) == 0.2
+    assert policy.backoff_bound_s(1) == 0.4
+
+
+def test_retrying_deadline_budget_stops_early():
+    from lance_distributed_training_tpu.utils.retry import (
+        RetryPolicy,
+        retrying,
+    )
+
+    # 100 attempts at >= 50 ms backoff cannot fit a 60 ms budget: the loop
+    # must stop after the sleeps it could afford, not drain the schedule.
+    policy = RetryPolicy(attempts=100, base_s=0.05, cap_s=0.05,
+                         deadline_s=0.06, jitter=False)
+    seen = list(retrying(policy))
+    assert 1 <= len(seen) <= 3
+
+
+def test_retrying_stop_event_interrupts():
+    from lance_distributed_training_tpu.utils.retry import (
+        RetryPolicy,
+        retrying,
+    )
+
+    stop = threading.Event()
+    stop.set()
+    gen = retrying(RetryPolicy(attempts=3), stop=stop,
+                   interrupt_message="closed during test")
+    with pytest.raises(ConnectionError, match="closed during test"):
+        next(gen)
+
+
+# -- preemption plumbing -----------------------------------------------------
+
+
+def test_preemption_handler_request_and_counter():
+    from lance_distributed_training_tpu.obs.registry import MetricsRegistry
+    from lance_distributed_training_tpu.utils.signals import (
+        PreemptionHandler,
+    )
+
+    reg = MetricsRegistry()
+    handler = PreemptionHandler(registry=reg)
+    assert not handler.requested
+    handler.request()
+    handler.request()  # idempotent: counted once
+    assert handler.requested
+    assert "trainer_preemptions_total 1" in reg.render_prometheus()
+
+
+def test_preemption_handler_real_sigterm():
+    import signal as signal_mod
+
+    from lance_distributed_training_tpu.utils.signals import (
+        PreemptionHandler,
+    )
+
+    before = signal_mod.getsignal(signal_mod.SIGTERM)
+    handler = PreemptionHandler().install()
+    try:
+        assert handler.installed
+        os.kill(os.getpid(), signal_mod.SIGTERM)
+        # Delivery happens at the next bytecode boundary on this thread.
+        assert handler.requested
+    finally:
+        handler.uninstall()
+    assert signal_mod.getsignal(signal_mod.SIGTERM) == before
+
+
+def test_trainer_chaos_spec_parsing():
+    from lance_distributed_training_tpu.utils.chaos import (
+        CHAOS_ENV,
+        TrainerChaos,
+    )
+
+    assert TrainerChaos.from_env({}) is None
+    chaos = TrainerChaos.from_env({CHAOS_ENV: "drain@7"})
+    assert chaos.action == "drain" and chaos.at_step == 7
+    fired = []
+    chaos.drain_cb = lambda: fired.append(True)
+    chaos.on_step(6)
+    assert not fired
+    chaos.on_step(7)
+    chaos.on_step(8)  # one-shot
+    assert fired == [True]
+    with pytest.raises(ValueError, match="expected"):
+        TrainerChaos.from_env({CHAOS_ENV: "sigkill"})
+    with pytest.raises(ValueError, match="action"):
+        TrainerChaos.from_env({CHAOS_ENV: "explode@3"})
+
+
+def test_batch_digest_canonical():
+    from lance_distributed_training_tpu.utils.chaos import batch_digest
+
+    a = {"x": np.arange(4, dtype=np.int32), "y": np.ones(2, np.float32)}
+    b = {"y": np.ones(2, np.float32), "x": np.arange(4, dtype=np.int32)}
+    assert batch_digest(a) == batch_digest(b)  # key order canonicalised
+    c = {"x": np.arange(4, dtype=np.int32), "y": np.zeros(2, np.float32)}
+    assert batch_digest(a) != batch_digest(c)
+    d = {"x": np.arange(4, dtype=np.int64), "y": np.ones(2, np.float32)}
+    assert batch_digest(a) != batch_digest(d)  # dtype is part of identity
+
+
+# -- end-to-end resume fidelity (slow tier) ----------------------------------
+
+
+@pytest.mark.slow
+def test_drain_resume_bit_identical_loss_trajectory(tmp_path, image_dataset):
+    """The acceptance chaos test, in-process: a run preempted (drain@5 —
+    the deterministic twin of SIGTERM) with step checkpoints resumes from
+    its awaited emergency checkpoint and consumes the exact remaining
+    batch sequence with losses matching the uninterrupted control arm
+    step-for-step. The SIGKILL twin (real subprocess death) runs in
+    scripts/preempt_smoke.py."""
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+    from lance_distributed_training_tpu.utils import chaos as C
+
+    def cfg(**kw):
+        base = dict(
+            dataset_path=image_dataset.uri, num_classes=10,
+            model_name="resnet18", image_size=32, batch_size=16, epochs=2,
+            lr=0.01, no_wandb=True, augment=False, eval_at_end=False,
+            log_every=0, seed=7,
+        )
+        base.update(kw)
+        return TrainConfig(**base)
+
+    def run(trace, chaos=None, **kw):
+        os.environ[C.TRACE_ENV] = str(tmp_path / trace)
+        if chaos:
+            os.environ[C.CHAOS_ENV] = chaos
+        try:
+            return train(cfg(**kw))
+        finally:
+            os.environ.pop(C.TRACE_ENV, None)
+            os.environ.pop(C.CHAOS_ENV, None)
+
+    run("control.jsonl")
+    control = C.read_trace(str(tmp_path / "control.jsonl"))
+    assert len(control) == 2 * (240 // 16)
+
+    ck = str(tmp_path / "ck")
+    r1 = run("pre.jsonl", chaos="drain@5", checkpoint_dir=ck,
+             checkpoint_every_steps=2)
+    assert r1["preempted"] is True and r1["steps"] == 5
+
+    r2 = run("resume.jsonl", checkpoint_dir=ck, checkpoint_every_steps=2)
+    assert "preempted" not in r2
+    resume = C.read_trace(str(tmp_path / "resume.jsonl"))
+    # The emergency checkpoint landed at step 5: resume starts at step 6 —
+    # no replayed batch, no skipped batch.
+    assert resume[0]["step"] == 6
+    assert resume[-1]["step"] == control[-1]["step"]
+    by_step = {t["step"]: t for t in control}
+    for t in resume:
+        ref = by_step[t["step"]]
+        assert t["batch_sha256"] == ref["batch_sha256"], t["step"]
+        assert t["loss"] == ref["loss"], (t["step"], t["loss"], ref["loss"])
