@@ -2,10 +2,17 @@
 
 An AST-based lint subsystem with project-specific rules: plan determinism
 (LDT001-003), jit purity (LDT101-102), concurrency hygiene (LDT201-203),
-resource ownership (LDT301), jax-compat enforcement (LDT401), and
-cross-module wire-protocol consistency (LDT501). Configured under
-``[tool.ldt-check]`` in pyproject.toml; per-line suppression via
-``# ldt: ignore[LDTxxx]``; grandfathered findings live in a baseline file.
+resource ownership (LDT301), jax-compat enforcement (LDT401), cross-module
+wire-protocol consistency (LDT501), and the whole-program concurrency
+model (``concmodel.py``): lock-order deadlock cycles (LDT1001),
+cross-thread unsynchronized shared state (LDT1002), dispatcher
+exhaustiveness over the protocol's MSG_* vocabulary (LDT1003) — with a
+runtime lock-order witness (``utils/lockorder.py`` +
+``ldt check --lock-witness``) corroborating or pruning the static cycles,
+and ``ldt graph --dot`` rendering the thread/lock topology. Configured
+under ``[tool.ldt-check]`` in pyproject.toml; per-line suppression via
+``# ldt: ignore[LDTxxx]`` (LDT10xx ignores require a ``-- reason``);
+grandfathered findings live in a baseline file.
 
 Programmatic surface::
 
@@ -23,17 +30,21 @@ from .core import (  # noqa: F401
     analyze_project,
     register,
 )
-from .cli import check_main  # noqa: F401
+from .cli import check_main, graph_main  # noqa: F401
+from .concmodel import ProgramInfo, build_program  # noqa: F401
 
 __all__ = [
     "CheckConfig",
     "Finding",
     "ModuleInfo",
+    "ProgramInfo",
     "Rule",
     "all_rules",
     "analyze",
     "analyze_project",
+    "build_program",
     "check_main",
+    "graph_main",
     "load_config",
     "register",
 ]

@@ -1,14 +1,23 @@
-"""``ldt check`` — run the distributed-training lint over the repo.
+"""``ldt check`` / ``ldt graph`` — the distributed-training lint CLI.
 
-Exit status is the gate contract: 0 when no NEW findings (relative to the
-baseline, when one exists), 1 when new findings are reported, 2 on usage
-errors. ``--update-baseline`` grandfathers the current findings so the gate
-can be adopted incrementally and ratcheted down.
+``check``: exit status is the gate contract — 0 when no NEW findings
+(relative to the baseline, when one exists), 1 when new findings are
+reported, 2 on usage errors. ``--update-baseline`` grandfathers the current
+findings so the gate can be adopted incrementally and ratcheted down.
+``--lock-witness`` feeds a runtime lock-order witness (emitted by the test
+suite under ``LDT_LOCK_SANITIZER=1``) into the LDT1001 cross-check:
+observed orderings corroborate static cycles, contradicted ones prune.
+
+``graph``: render the cross-module concurrency model (spawned-thread
+roots, the locks each thread path acquires, the lock-order edges) as
+Graphviz DOT (``--dot``) or a text summary — the machine-checked topology
+the README renders.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from typing import Optional, Sequence
@@ -18,19 +27,20 @@ from .core import (
     all_rules,
     analyze_project,
     load_baseline,
+    parse_modules,
     split_new_findings,
     write_baseline,
 )
 from .reporters import render_json, render_text
 
-__all__ = ["check_main", "build_check_parser"]
+__all__ = ["check_main", "build_check_parser", "graph_main"]
 
 
 def build_check_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ldt check",
         description="AST-based distributed-training lint "
-                    "(rules LDT001-LDT601; config in [tool.ldt-check])",
+                    "(rules LDT001-LDT1003; config in [tool.ldt-check])",
     )
     p.add_argument("paths", nargs="*", default=None,
                    help="files/dirs to check (default: configured paths)")
@@ -44,9 +54,41 @@ def build_check_parser() -> argparse.ArgumentParser:
                         "exit 0 — future runs fail only on NEW findings")
     p.add_argument("--no-baseline", action="store_true",
                    help="ignore the baseline: report every finding as new")
+    p.add_argument("--lock-witness", default=None, metavar="PATH",
+                   help="runtime lock-order witness JSON (emitted by a "
+                        "test run under LDT_LOCK_SANITIZER=1): observed "
+                        "orderings corroborate LDT1001 cycles, "
+                        "contradicted ones are marked witness_pruned and "
+                        "do not fail the gate")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule registry and exit")
     return p
+
+
+def load_lock_witness(path: str, root: str) -> dict:
+    """Parse a ``utils/lockorder.py`` witness file into the structure the
+    LDT1001 rule consumes: ``{"edges": {(src, dst), ...}, "acquired":
+    {site: count}}`` with sites relativized to ``root`` (``path:line``)."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+
+    def rel_site(site: str) -> str:
+        file_part, _, line = site.rpartition(":")
+        try:
+            rel = os.path.relpath(file_part, root)
+        except ValueError:  # different drive (windows): keep absolute
+            rel = file_part
+        return f"{rel.replace(os.sep, '/')}:{line}"
+
+    edges = {
+        (rel_site(e["src"]), rel_site(e["dst"]))
+        for e in data.get("edges", [])
+    }
+    acquired = {
+        rel_site(site): count
+        for site, count in data.get("acquired", {}).items()
+    }
+    return {"edges": edges, "acquired": acquired}
 
 
 def check_main(argv: Optional[Sequence[str]] = None,
@@ -75,8 +117,20 @@ def check_main(argv: Optional[Sequence[str]] = None,
             )
             return 2
         config.paths = list(args.paths)
+    if args.lock_witness:
+        try:
+            config.lock_witness = load_lock_witness(args.lock_witness, root)
+        except (OSError, ValueError, KeyError, TypeError) as exc:
+            out.write(
+                f"ldt check: unreadable lock witness "
+                f"{args.lock_witness}: {exc}\n"
+            )
+            return 2
 
-    findings, modules, files_checked = analyze_project(root, config)
+    timing: dict = {}
+    findings, modules, files_checked = analyze_project(
+        root, config, timing=timing
+    )
     by_path = {m.relpath: m for m in modules}
     if files_checked == 0:
         # Scanning nothing is a misconfiguration (wrong cwd, bad --root,
@@ -90,10 +144,13 @@ def check_main(argv: Optional[Sequence[str]] = None,
 
     baseline_path = os.path.join(root, config.baseline)
     if args.update_baseline:
-        write_baseline(baseline_path, findings, root, modules)
+        # Witness-pruned findings never enter the baseline: they are
+        # evidence-contradicted, not grandfathered debt.
+        solid = [f for f in findings if not f.witness_pruned]
+        write_baseline(baseline_path, solid, root, modules)
         out.write(
             f"ldt check: baseline written to {config.baseline} "
-            f"({len(findings)} finding{'s' if len(findings) != 1 else ''})\n"
+            f"({len(solid)} finding{'s' if len(solid) != 1 else ''})\n"
         )
         return 0
 
@@ -103,6 +160,12 @@ def check_main(argv: Optional[Sequence[str]] = None,
         baseline = load_baseline(baseline_path)
         new, old = split_new_findings(findings, baseline, root, modules)
 
+    rules = all_rules()
+
+    def family_of(rule_id: str) -> str:
+        rule = rules.get(rule_id)
+        return getattr(rule, "family", "general") if rule else "general"
+
     if args.as_json:
         def line_text_of(f):
             mod = by_path.get(f.path)
@@ -111,12 +174,128 @@ def check_main(argv: Optional[Sequence[str]] = None,
         render_json(
             new, out, root=root, grandfathered=len(old),
             files_checked=files_checked, line_text_of=line_text_of,
+            family_of=family_of, timing=timing,
         )
     else:
         render_text(
             new, out, grandfathered=len(old), files_checked=files_checked
         )
-    return 1 if new else 0
+    return 1 if any(not f.witness_pruned for f in new) else 0
+
+
+# -- ldt graph ---------------------------------------------------------------
+
+
+def build_graph_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ldt graph",
+        description="render the cross-module concurrency model (thread "
+                    "roots, lock acquisitions, lock-order edges)",
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to model (default: configured paths)")
+    p.add_argument("--root", default=".",
+                   help="repo root (config + relative paths)")
+    p.add_argument("--dot", action="store_true",
+                   help="Graphviz DOT on stdout (pipe through `dot -Tsvg`)"
+                        " instead of the text summary")
+    return p
+
+
+def _short(key: str) -> str:
+    parts = key.split(".")
+    return ".".join(parts[-2:]) if len(parts) >= 2 else key
+
+
+def graph_main(argv: Optional[Sequence[str]] = None, out=None) -> int:
+    """The ``ldt graph`` entry point. Returns the process exit status."""
+    args = build_graph_parser().parse_args(
+        list(argv) if argv is not None else None
+    )
+    out = out if out is not None else sys.stdout
+    root = os.path.abspath(args.root)
+    config = load_config(root)
+    if args.paths:
+        config.paths = list(args.paths)
+    # Parse only — the graph needs the module set for the concurrency
+    # model, not a full lint pass over every rule.
+    modules, _parse_findings, files_checked = parse_modules(root, config)
+    if files_checked == 0:
+        out.write(
+            f"ldt graph: no files matched {config.paths} under {root}\n"
+        )
+        return 2
+    from .concmodel import build_program
+
+    program = build_program(modules, config)
+
+    # thread root -> set of lock keys any function on that root acquires
+    root_locks: dict = {}
+    spawn_targets = sorted(
+        {t for t, _m, _n in program.spawn_sites if t is not None}
+    )
+    for target in spawn_targets:
+        locks = set()
+        for fn in program.functions.values():
+            if target in fn.roots:
+                locks |= {lk for lk, _n in fn.acquires}
+        root_locks[target] = locks
+
+    if args.dot:
+        out.write("digraph ldt_concurrency {\n")
+        out.write("  rankdir=LR;\n")
+        out.write('  node [fontname="monospace", fontsize=10];\n')
+        for target in spawn_targets:
+            out.write(
+                f'  "thread:{target}" [label="{_short(target)}", '
+                'shape=box, style=filled, fillcolor="#dbeafe"];\n'
+            )
+        for key in sorted(program.locks):
+            out.write(
+                f'  "lock:{key}" [label="{_short(key)}", shape=ellipse, '
+                'style=filled, fillcolor="#fef3c7"];\n'
+            )
+        for target in spawn_targets:
+            for lk in sorted(root_locks[target]):
+                out.write(
+                    f'  "thread:{target}" -> "lock:{lk}" '
+                    '[color="#64748b"];\n'
+                )
+        seen = set()
+        for e in program.lock_edges:
+            if (e.src, e.dst) in seen:
+                continue
+            seen.add((e.src, e.dst))
+            out.write(
+                f'  "lock:{e.src}" -> "lock:{e.dst}" '
+                f'[color="#dc2626", penwidth=2, '
+                f'label="{e.module}:{e.line}"];\n'
+            )
+        out.write("}\n")
+    else:
+        out.write(f"concurrency model over {files_checked} files: "
+                  f"{len(program.functions)} functions, "
+                  f"{len(spawn_targets)} thread roots, "
+                  f"{len(program.locks)} locks, "
+                  f"{len(program.lock_edges)} lock-order edges\n")
+        for target in spawn_targets:
+            on_root = sum(
+                1 for fn in program.functions.values()
+                if target in fn.roots
+            )
+            locks = ", ".join(sorted(_short(k) for k in root_locks[target]))
+            out.write(f"  thread {_short(target)}: {on_root} functions"
+                      f"{' — locks: ' + locks if locks else ''}\n")
+        seen = set()
+        for e in program.lock_edges:
+            if (e.src, e.dst) in seen:
+                continue
+            seen.add((e.src, e.dst))
+            out.write(f"  order {_short(e.src)} -> {_short(e.dst)} "
+                      f"({e.module}:{e.line}, {e.via})\n")
+        cycles = program.lock_cycles()
+        out.write(f"  lock-order cycles: {len(cycles)}\n")
+    return 0
 
 
 if __name__ == "__main__":

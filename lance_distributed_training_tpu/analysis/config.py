@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 __all__ = ["CheckConfig", "load_config"]
 
@@ -66,6 +66,43 @@ class CheckConfig:
             "lance_distributed_training_tpu/analysis/core.py",
         ]
     )
+    # LDT1003: dispatcher exhaustiveness — each dispatcher module's inbound
+    # message vocabulary. Every ``MSG_*`` constant in the protocol module
+    # must appear in at least one entry, and each listed constant must be
+    # behaviorally dispatched (compared against a received message type, or
+    # keyed in a handler dict) in that module. TOML: a
+    # ``[tool.ldt-check.dispatch]`` table of module-path → constant list.
+    dispatch: Dict[str, List[str]] = dataclasses.field(
+        default_factory=lambda: {
+            "lance_distributed_training_tpu/service/server.py": [
+                "MSG_HELLO", "MSG_ACK", "MSG_ERROR",
+            ],
+            "lance_distributed_training_tpu/service/client.py": [
+                "MSG_HELLO_OK", "MSG_BATCH", "MSG_END", "MSG_ERROR",
+            ],
+            "lance_distributed_training_tpu/fleet/balancer.py": [
+                "MSG_HELLO_OK", "MSG_BATCH", "MSG_END", "MSG_ERROR",
+                "MSG_FLEET_RESOLVE_OK",
+            ],
+            "lance_distributed_training_tpu/fleet/coordinator.py": [
+                "MSG_FLEET_REGISTER", "MSG_FLEET_HEARTBEAT",
+                "MSG_FLEET_DEREGISTER", "MSG_FLEET_RESOLVE",
+            ],
+            "lance_distributed_training_tpu/fleet/agent.py": [
+                "MSG_FLEET_REGISTER_OK", "MSG_FLEET_HEARTBEAT_OK",
+                "MSG_FLEET_DEREGISTER_OK", "MSG_ERROR",
+            ],
+        }
+    )
+    # LDT1002: constructors whose instances are internally synchronized —
+    # a shared attribute holding one is a sanctioned handoff, not a race.
+    # Matched as suffixes of the import-resolved constructor qualname;
+    # empty list = the built-in default set (concmodel module).
+    threadsafe_types: List[str] = dataclasses.field(default_factory=list)
+    # LDT1001 runtime witness (``ldt check --lock-witness``): set by the
+    # CLI, never from TOML — {"edges": {(src, dst), ...},
+    # "acquired": {site: count}} with root-relative "path:line" sites.
+    lock_witness: Optional[dict] = None
     # LDT701: the hot-path modules where materialising copies
     # (.to_pylist(), bytes(view[...])) undo the zero-copy batch plane.
     hot_paths: List[str] = dataclasses.field(
@@ -119,6 +156,8 @@ def load_config(root: str) -> CheckConfig:
         "obs-paths": "obs_paths",
         "hot-paths": "hot_paths",
         "state-paths": "state_paths",
+        "dispatch": "dispatch",
+        "threadsafe-types": "threadsafe_types",
     }
     for key, attr in mapping.items():
         if key in section:

@@ -40,6 +40,7 @@ __all__ = [
     "all_rules",
     "analyze",
     "analyze_project",
+    "parse_modules",
     "load_baseline",
     "write_baseline",
     "fingerprint",
@@ -49,13 +50,20 @@ __all__ = [
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    """One rule violation at a specific source location."""
+    """One rule violation at a specific source location.
+
+    ``witness_pruned`` is set (never by hand — by the LDT1001 witness
+    cross-check) when runtime lock-order evidence contradicts the static
+    inference: the finding still renders (flagged) but does not fail the
+    gate and never enters a baseline.
+    """
 
     rule: str  # "LDT001"
     path: str  # root-relative posix path
     line: int  # 1-based
     col: int  # 0-based
     message: str
+    witness_pruned: bool = False
 
     def location(self) -> str:
         return f"{self.path}:{self.line}:{self.col + 1}"
@@ -63,13 +71,23 @@ class Finding:
 
 _SUPPRESS_RE = re.compile(
     r"#\s*ldt:\s*ignore(?:\[(?P<rules>[A-Za-z0-9_,\s]+)\])?"
+    r"(?:\s*(?:--|—)\s*(?P<reason>\S.*))?"
 )
 
+# The cross-module concurrency rules: their findings assert whole-program
+# properties (a deadlock cycle, a cross-thread race), so an unexplained
+# per-line ignore is exactly the "trust me" a reviewer cannot review.
+# Suppressions for these require a reason string:
+#     # ldt: ignore[LDT1002] -- GIL-atomic monotonic cursor, torn reads ok
+_REASON_REQUIRED_RE = re.compile(r"LDT10\d\d$")
 
-def _parse_suppressions(lines: Sequence[str]) -> Dict[int, Optional[set]]:
-    """Per-line suppressions: line number → set of rule ids, or ``None``
-    meaning "suppress every rule on this line" (bare ``# ldt: ignore``)."""
-    out: Dict[int, Optional[set]] = {}
+
+def _parse_suppressions(lines: Sequence[str]) -> Dict[int, tuple]:
+    """Per-line suppressions: line number → ``(rules, reason)`` where
+    ``rules`` is a set of rule ids or ``None`` meaning "every rule" (bare
+    ``# ldt: ignore``), and ``reason`` is the free text after ``--`` (or
+    ``None`` when absent — LDT10xx rules refuse reasonless ignores)."""
+    out: Dict[int, tuple] = {}
     for i, text in enumerate(lines, start=1):
         if "ldt:" not in text:  # cheap pre-filter
             continue
@@ -77,10 +95,10 @@ def _parse_suppressions(lines: Sequence[str]) -> Dict[int, Optional[set]]:
         if not m:
             continue
         rules = m.group("rules")
-        if rules is None:
-            out[i] = None
-        else:
-            out[i] = {r.strip().upper() for r in rules.split(",") if r.strip()}
+        reason = m.group("reason")
+        if rules is not None:
+            rules = {r.strip().upper() for r in rules.split(",") if r.strip()}
+        out[i] = (rules, reason.strip() if reason else None)
     return out
 
 
@@ -196,10 +214,18 @@ class ModuleInfo:
         return cur
 
     def suppressed(self, finding: Finding) -> bool:
-        rules = self.suppressions.get(finding.line, "missing")
-        if rules == "missing":
+        entry = self.suppressions.get(finding.line)
+        if entry is None:
             return False
-        return rules is None or finding.rule in rules
+        rules, reason = entry
+        if rules is not None and finding.rule not in rules:
+            return False
+        if _REASON_REQUIRED_RE.match(finding.rule) and not reason:
+            # A bare ignore on an LDT10xx finding is ineffective by design:
+            # the finding stays live, so the lint fails until the ignore
+            # carries a `-- reason`.
+            return False
+        return True
 
     def line_text(self, line: int) -> str:
         if 1 <= line <= len(self.lines):
@@ -208,12 +234,15 @@ class ModuleInfo:
 
 
 class Rule:
-    """Base class. Subclass, set ``id``/``name``/``description``, implement
-    ``check_module`` and/or ``check_project``, decorate with ``@register``."""
+    """Base class. Subclass, set ``id``/``name``/``description`` (and
+    ``family`` — the ``rule_family`` the JSON reporter emits), implement
+    ``check_module``, ``check_project``, and/or ``check_program``, decorate
+    with ``@register``."""
 
     id: str = ""
     name: str = ""
     description: str = ""
+    family: str = "general"
 
     def check_module(self, module: ModuleInfo, config) -> Iterable[Finding]:
         return ()
@@ -221,6 +250,13 @@ class Rule:
     def check_project(
         self, modules: Sequence[ModuleInfo], config
     ) -> Iterable[Finding]:
+        return ()
+
+    def check_program(self, program, config) -> Iterable[Finding]:
+        """Cross-module rules over the shared concurrency model
+        (:class:`~.concmodel.ProgramInfo`) — built ONCE per run and handed
+        to every rule that overrides this, instead of each rule re-walking
+        every AST."""
         return ()
 
 
@@ -291,22 +327,55 @@ def analyze(root: str, config) -> List[Finding]:
     return analyze_project(root, config)[0]
 
 
-def analyze_project(root: str, config):
-    """:func:`analyze` plus the parsed modules and total file count —
-    ``(findings, modules, files_checked)``. The CLI uses the extras for
-    reporting (line text, counts) without re-reading anything."""
+# Parse cache: (root, relpath, mtime_ns, size) → ModuleInfo. One `ldt
+# check` run parses each file exactly once already; this carries the
+# parses ACROSS runs in the same process (the test suite runs the
+# full-repo analysis half a dozen times; the CLI pays one stat per file on
+# a warm cache). ModuleInfo is never mutated after construction, so
+# sharing is safe. Root and relpath are part of the key deliberately: a
+# ModuleInfo's identity (its reported path, its dotted name, every
+# relpath-keyed config match) depends on the root it was loaded under —
+# the same file analyzed from a different root must be a different entry.
+_MODULE_CACHE: Dict[tuple, ModuleInfo] = {}
+_MODULE_CACHE_MAX = 1024
+
+
+def _load_module(root: str, rel: str) -> ModuleInfo:
+    full = os.path.join(root, rel)
+    try:
+        st = os.stat(full)
+        key = (os.path.abspath(root), rel, st.st_mtime_ns, st.st_size)
+    except OSError:
+        key = None
+    if key is not None:
+        cached = _MODULE_CACHE.get(key)
+        if cached is not None:
+            return cached
+    with open(full, encoding="utf-8") as f:
+        source = f.read()
+    mod = ModuleInfo(root, rel, source)
+    if key is not None:
+        if len(_MODULE_CACHE) >= _MODULE_CACHE_MAX:
+            _MODULE_CACHE.clear()
+        _MODULE_CACHE[key] = mod
+    return mod
+
+
+def parse_modules(root: str, config):
+    """Parse (or cache-hit) every configured file WITHOUT running rules —
+    ``(modules, findings, files_checked)`` where findings are the LDT000
+    parse failures. ``ldt graph`` uses this directly: it needs the module
+    set for the concurrency model, not a lint pass."""
     modules: List[ModuleInfo] = []
     findings: List[Finding] = []
     files_checked = 0
     for rel in _iter_py_files(root, config.paths, config.exclude):
         files_checked += 1
         try:
-            with open(os.path.join(root, rel), encoding="utf-8") as f:
-                source = f.read()
+            mod = _load_module(root, rel)
         except OSError as exc:
             findings.append(Finding("LDT000", rel, 1, 0, f"unreadable: {exc}"))
             continue
-        mod = ModuleInfo(root, rel, source)
         if mod.syntax_error is not None:
             findings.append(
                 Finding(
@@ -316,16 +385,44 @@ def analyze_project(root: str, config):
             )
             continue
         modules.append(mod)
+    return modules, findings, files_checked
+
+
+def analyze_project(root: str, config, timing: Optional[dict] = None):
+    """:func:`analyze` plus the parsed modules and total file count —
+    ``(findings, modules, files_checked)``. The CLI uses the extras for
+    reporting (line text, counts) without re-reading anything. ``timing``
+    (a dict, filled in place) receives ``wall_ms`` / ``parse_ms`` for the
+    ``--json`` report."""
+    import time as _time
+
+    t_start = _time.perf_counter()
+    modules, findings, files_checked = parse_modules(root, config)
+    t_parsed = _time.perf_counter()
 
     rules = {
         rid: rule for rid, rule in all_rules().items()
         if rid not in config.disable
     }
     by_path = {m.relpath: m for m in modules}
+    # The cross-module concurrency model is built at most ONCE per run and
+    # shared by every program-level rule (LDT1001-1003 all consume it).
+    program = None
+    if any(
+        type(rule).check_program is not Rule.check_program
+        for rule in rules.values()
+    ):
+        from .concmodel import build_program
+
+        program = build_program(modules, config)
     for rule in rules.values():
         for mod in modules:
             findings.extend(rule.check_module(mod, config))
         findings.extend(rule.check_project(modules, config))
+        if program is not None and (
+            type(rule).check_program is not Rule.check_program
+        ):
+            findings.extend(rule.check_program(program, config))
 
     kept = []
     for f in findings:
@@ -334,6 +431,10 @@ def analyze_project(root: str, config):
             continue
         kept.append(f)
     kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    if timing is not None:
+        t_end = _time.perf_counter()
+        timing["parse_ms"] = round((t_parsed - t_start) * 1e3, 3)
+        timing["wall_ms"] = round((t_end - t_start) * 1e3, 3)
     return kept, modules, files_checked
 
 

@@ -18,16 +18,28 @@ def render_text(
     files_checked: int = 0,
 ) -> None:
     """flake8-style one-line-per-finding in stable (path, line, rule) order,
-    followed by a summary line the gate scripts can grep."""
+    followed by a summary line the gate scripts can grep. Witness-pruned
+    findings (runtime evidence contradicts the static inference) render
+    flagged and do not count toward the failing total."""
+    failing = 0
+    pruned = 0
     for f in findings:
-        out.write(f"{f.location()}: {f.rule} {f.message}\n")
-    if findings:
+        tag = ""
+        if f.witness_pruned:
+            pruned += 1
+            tag = " [witness-pruned]"
+        else:
+            failing += 1
+        out.write(f"{f.location()}: {f.rule} {f.message}{tag}\n")
+    if failing:
         out.write(
-            f"\nldt check: {len(findings)} new finding"
-            f"{'s' if len(findings) != 1 else ''}"
+            f"\nldt check: {failing} new finding"
+            f"{'s' if failing != 1 else ''}"
         )
     else:
         out.write("ldt check: clean")
+    if pruned:
+        out.write(f" ({pruned} witness-pruned)")
     if grandfathered:
         out.write(f" ({grandfathered} baselined)")
     out.write(f" [{files_checked} files]\n")
@@ -41,18 +53,29 @@ def render_json(
     grandfathered: int = 0,
     files_checked: int = 0,
     line_text_of=None,
+    family_of=None,
+    timing: Optional[dict] = None,
 ) -> None:
     """Machine output. Schema (stable — tests pin it)::
 
         {
-          "version": 1,
-          "clean": bool,
+          "version": 2,
+          "clean": bool,             # no UNPRUNED new findings
           "files_checked": int,
           "grandfathered": int,
+          "wall_time_ms": float,     # whole analysis pass (parse + rules)
+          "parse_ms": float,
           "findings": [
-            {"rule", "path", "line", "col", "message", "fingerprint"}, ...
+            {"rule", "rule_family", "path", "line", "col", "message",
+             "fingerprint", "witness_pruned"}, ...
           ]
         }
+
+    v1 → v2: per-finding ``rule_family`` (the rule's family slug, e.g.
+    ``lock-order``) and ``witness_pruned`` (true when the runtime lock
+    witness contradicted the static inference — rendered, not failing),
+    plus the top-level timing fields. Exit-code and baseline semantics are
+    unchanged, so existing gate machinery keeps working unmodified.
     """
     records = []
     for f in findings:
@@ -60,22 +83,23 @@ def render_json(
         records.append(
             {
                 "rule": f.rule,
+                "rule_family": family_of(f.rule) if family_of else "general",
                 "path": f.path,
                 "line": f.line,
                 "col": f.col,
                 "message": f.message,
                 "fingerprint": fingerprint(f, text),
+                "witness_pruned": bool(f.witness_pruned),
             }
         )
-    json.dump(
-        {
-            "version": 1,
-            "clean": not findings,
-            "files_checked": files_checked,
-            "grandfathered": grandfathered,
-            "findings": records,
-        },
-        out,
-        indent=2,
-    )
+    payload = {
+        "version": 2,
+        "clean": not any(not f.witness_pruned for f in findings),
+        "files_checked": files_checked,
+        "grandfathered": grandfathered,
+        "wall_time_ms": (timing or {}).get("wall_ms", 0.0),
+        "parse_ms": (timing or {}).get("parse_ms", 0.0),
+        "findings": records,
+    }
+    json.dump(payload, out, indent=2)
     out.write("\n")

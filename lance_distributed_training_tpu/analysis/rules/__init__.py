@@ -12,10 +12,13 @@ from . import (  # noqa: F401  (import for registration side effect)
     concurrency,
     copies,
     determinism,
+    dispatch,
     jit_purity,
+    lockorder,
     obs,
     persistence,
     placement,
     protocol,
     resources,
+    sharedstate,
 )

@@ -19,6 +19,7 @@ from ..core import Finding, ModuleInfo, Rule, register
 @register
 class DirectCompatImport(Rule):
     id = "LDT401"
+    family = "compat"
     name = "direct-compat-import"
     description = (
         "version-moved jax symbol (shard_map/pcast/axis_size) imported or "

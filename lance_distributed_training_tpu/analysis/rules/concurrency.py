@@ -33,6 +33,7 @@ _HELLO_MARKERS = ("HELLO", "handshake")
 @register
 class ThreadLifecycle(Rule):
     id = "LDT201"
+    family = "concurrency"
     name = "thread-lifecycle"
     description = (
         "threading.Thread without an explicit daemon= and without a "
@@ -92,6 +93,7 @@ class ThreadLifecycle(Rule):
 @register
 class UnboundedQueue(Rule):
     id = "LDT202"
+    family = "concurrency"
     name = "unbounded-queue"
     description = (
         "queue.Queue() without maxsize on a streaming path — voids the "
@@ -144,6 +146,7 @@ class UnboundedQueue(Rule):
 @register
 class HandshakeRecvTimeout(Rule):
     id = "LDT203"
+    family = "concurrency"
     name = "handshake-recv-timeout"
     description = (
         "blocking recv on a handshake path with no prior settimeout — a "

@@ -34,6 +34,7 @@ _MATERIALIZERS = {"to_pylist", "to_pybytes"}
 @register
 class CopyHygiene(Rule):
     id = "LDT701"
+    family = "copies"
     name = "copy-hygiene"
     description = (
         "hot-path modules: no .to_pylist()/.to_pybytes() on Arrow columns "

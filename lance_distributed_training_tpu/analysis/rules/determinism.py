@@ -40,6 +40,7 @@ _LISTING_METHODS = {"glob", "iglob", "iterdir", "rglob"}  # pathlib-style
 @register
 class UnseededGlobalRng(Rule):
     id = "LDT001"
+    family = "determinism"
     name = "unseeded-global-rng"
     description = (
         "np.random.* / random.* global-state call — plan and shuffle "
@@ -72,6 +73,7 @@ class UnseededGlobalRng(Rule):
 @register
 class WallClockSeed(Rule):
     id = "LDT002"
+    family = "determinism"
     name = "wall-clock-seed"
     description = (
         "time.time()/datetime.now() feeding seed/plan/shuffle construction "
@@ -130,6 +132,7 @@ class WallClockSeed(Rule):
 @register
 class UnsortedListing(Rule):
     id = "LDT003"
+    family = "determinism"
     name = "unsorted-fs-listing"
     description = (
         "os.listdir/glob results used without sorted() — filesystem order "

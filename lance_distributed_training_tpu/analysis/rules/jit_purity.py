@@ -94,6 +94,7 @@ def _params_of(fn: ast.AST) -> Set[str]:
 @register
 class JitSideEffect(Rule):
     id = "LDT101"
+    family = "jit-purity"
     name = "jit-side-effect"
     description = (
         "print/logging/wandb/clock call inside a jax.jit-compiled function "
@@ -134,6 +135,7 @@ class JitSideEffect(Rule):
 @register
 class JitHostSync(Rule):
     id = "LDT102"
+    family = "jit-purity"
     name = "jit-host-sync"
     description = (
         ".item()/jax.device_get/np.asarray/float() on traced values inside "

@@ -37,6 +37,7 @@ _METRIC_FACTORIES = {"counter", "gauge", "histogram"}
 @register
 class ObsHygiene(Rule):
     id = "LDT601"
+    family = "obs"
     name = "obs-hygiene"
     description = (
         "instrumented modules: no time.time() (durations need "

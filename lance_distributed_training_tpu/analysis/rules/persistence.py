@@ -47,6 +47,7 @@ def _write_mode(node: ast.Call) -> str:
 @register
 class NonAtomicStateWrite(Rule):
     id = "LDT901"
+    family = "persistence"
     name = "non-atomic-state-write"
     description = (
         "truncating file write in a state-persisting module without "

@@ -46,6 +46,7 @@ _PLACEMENT_MODULE_SUFFIX = "data/placement.py"
 @register
 class PlacementHygiene(Rule):
     id = "LDT801"
+    family = "placement"
     name = "placement-hygiene"
     description = (
         "hot-path modules: no direct jax.device_put / "

@@ -47,6 +47,7 @@ def _module_constants(module: ModuleInfo) -> dict:
 @register
 class ProtocolConsistency(Rule):
     id = "LDT501"
+    family = "protocol"
     name = "protocol-consistency"
     description = (
         "frame-type/version constant referenced on the protocol module but "

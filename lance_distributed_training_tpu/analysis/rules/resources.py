@@ -39,6 +39,7 @@ _CLOSE_METHODS = {"close", "shutdown", "stop", "__exit__", "__del__"}
 @register
 class UnclosedResource(Rule):
     id = "LDT301"
+    family = "resources"
     name = "unclosed-resource"
     description = (
         "open()/socket result without a visible ownership story (with / "

@@ -5,7 +5,7 @@ Flag-for-flag parity with ``/root/reference/lance_iterable.py:136-146`` (plus
 ``lance_map_style.py:128-148``, and TPU knobs). Topology comes from JAX
 process discovery, not torchrun env vars (``lance_iterable.py:154-156``).
 
-Five subcommands share the ``ldt`` entry point:
+Six subcommands share the ``ldt`` entry point:
 
 * ``ldt train …`` (or bare flags, backward-compatible) — the trainer;
 * ``ldt serve-data …`` — the disaggregated input-data service: decode on
@@ -16,6 +16,8 @@ Five subcommands share the ``ldt`` entry point:
   ``--coordinator host:port`` (README "Fleet");
 * ``ldt check …`` — the AST-based distributed-training lint (exits
   non-zero on new findings; see README "Static analysis");
+* ``ldt graph …`` — the cross-module concurrency model (spawned threads,
+  locks, lock-order edges) as Graphviz DOT or a text summary;
 * ``ldt trace export …`` — convert recorded span JSONL (LDT_TRACE_PATH)
   into a Perfetto-loadable Chrome trace (see README "Telemetry").
 
@@ -416,6 +418,12 @@ def main(argv=None) -> dict:
         from .analysis.cli import check_main
 
         return check_main(argv[1:])
+    if argv and argv[0] == "graph":
+        # The cross-module concurrency model (thread roots, locks,
+        # lock-order edges) as DOT (--dot) or a text summary.
+        from .analysis.cli import graph_main
+
+        return graph_main(argv[1:])
     if argv and argv[0] == "trace":
         # Telemetry export: span JSONL (LDT_TRACE_PATH) → Chrome-trace JSON
         # loadable in Perfetto. Returns an int exit status.

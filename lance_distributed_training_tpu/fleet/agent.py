@@ -178,8 +178,20 @@ class FleetAgent:
             self._thread = None
         if deregister and self.registered.is_set():
             try:
-                self._call(P.MSG_FLEET_DEREGISTER,
-                           {"server_id": self.server_id})
+                msg_type, _reply = self._call(
+                    P.MSG_FLEET_DEREGISTER, {"server_id": self.server_id}
+                )
+                if msg_type == P.MSG_FLEET_DEREGISTER_OK:
+                    self._count("fleet_deregistrations")
+                else:
+                    # An ERROR answer (or a future coordinator speaking a
+                    # frame type this build does not know) means the lease
+                    # may NOT have been released — it will go the hard way,
+                    # at TTL expiry. Count it so the drain path's
+                    # best-effort nature is observable (LDT1003: every
+                    # inbound frame type gets a behavior, not a
+                    # fall-through).
+                    self._count("fleet_deregister_errors")
             except (ConnectionError, OSError, P.ProtocolError):
                 pass  # coordinator gone: expiry will reap the lease
         self.registered.clear()

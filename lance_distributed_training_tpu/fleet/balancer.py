@@ -116,8 +116,11 @@ class _StripeRound:
         self.threads: list = []
         self.socks: list = []
         self.failed = threading.Event()
+        # Published BEFORE failed.set(); consumers read it only after
+        # failed.is_set() — the Event's set/is_set pair orders the write
+        # against every read (the same handoff discipline LDT1002 wants).
         self.failed_addr: Optional[str] = None
-        self.closed = False
+        self.closed = threading.Event()  # teardown flag: close() → pumps
 
     def connect(self) -> None:
         """Dial every member's stripe. Raises :class:`_StripeFailure` (all
@@ -142,7 +145,7 @@ class _StripeRound:
 
     def _fail(self, addr: str) -> None:
         if not self.failed.is_set():
-            self.failed_addr = addr
+            self.failed_addr = addr  # ldt: ignore[LDT1002] -- published before failed.set(); readers gate on is_set(), so the Event orders this write
             self.failed.set()
 
     def _pump(self, i: int, addr: str, sock: socket.socket) -> None:
@@ -159,7 +162,7 @@ class _StripeRound:
                 try:
                     msg_type, payload = reader.recv_msg()
                 except (ConnectionError, OSError) as exc:
-                    if not (self.closed or self.stop.is_set()):
+                    if not (self.closed.is_set() or self.stop.is_set()):
                         self._fail(addr)
                     return
                 if msg_type == P.MSG_BATCH:
@@ -210,7 +213,7 @@ class _StripeRound:
     def _put(self, i: int, item) -> None:
         """Bounded put that a close() can always unblock (the queue is
         drained on teardown, so a blocked pump exits within one timeout)."""
-        while not (self.closed or self.stop.is_set()):
+        while not (self.closed.is_set() or self.stop.is_set()):
             try:
                 self.queues[i].put(item, timeout=0.25)
                 return
@@ -255,7 +258,7 @@ class _StripeRound:
         batch's pool leases (a failover drops up to
         ``n * stripe_queue_depth`` decoded batches — they must go back to
         the pool, not strand)."""
-        self.closed = True
+        self.closed.set()
         for sock in self.socks:
             try:
                 # shutdown BEFORE close: a pump blocked in recv holds the
@@ -366,7 +369,10 @@ class FleetLoader:
         step = int(state.get("step", 0))
         if step < 0:
             raise ValueError(f"negative resume cursor: {step}")
-        self._start_step = step
+        # Resume cursor: loaded between iterations, while no receiver
+        # thread is live (the checkpoint-restore contract in
+        # data/pipeline.py) — happens-before the next __iter__ spawn.
+        self._start_step = step  # ldt: ignore[LDT1002] -- set while quiescent, before __iter__ spawns the receiver
         self._yielded = step
 
     # -- coordinator --------------------------------------------------------
@@ -514,7 +520,7 @@ class FleetLoader:
                         f"{reply.get('version')} < {P.STRIPE_MIN_VERSION} "
                         "(no stripe support) — upgrade it before fleeting"
                     )
-                self._num_steps = int(reply["num_steps"])
+                self._num_steps = int(reply["num_steps"])  # ldt: ignore[LDT1002] -- idempotent plan-length cache: every writer stores the same value for a given epoch
                 sock.settimeout(None)  # streaming phase: no recv deadline
                 sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
                 return sock
@@ -558,10 +564,12 @@ class FleetLoader:
     def set_epoch(self, epoch: int) -> None:
         """Reshuffle parity with ``RemoteLoader.set_epoch``."""
         if epoch != self.epoch:
-            self.epoch = epoch
-            self._num_steps = None
+            # Epoch rollover runs between epochs, while no receiver
+            # thread is live — happens-before the next __iter__ spawn.
+            self.epoch = epoch  # ldt: ignore[LDT1002] -- set while quiescent, before __iter__ spawns the receiver
+            self._num_steps = None  # ldt: ignore[LDT1002] -- set while quiescent, before __iter__ spawns the receiver
             # A new epoch's plan starts at its own step 0.
-            self._start_step = 0
+            self._start_step = 0  # ldt: ignore[LDT1002] -- set while quiescent, before __iter__ spawns the receiver
             self._yielded = 0
 
     def _release(self, batch) -> None:

@@ -30,6 +30,20 @@ deadline, nothing more.
 Thread & queue policy (``ldt check`` LDT201/LDT203): every thread is
 ``daemon=True``; every control recv carries a deadline. The coordinator has
 no queues — its whole state is the lease table under one lock.
+
+Lock discipline (LDT1001/LDT1002 audit, r9): ``_lock`` guards the member
+table and generation counter across seven sites — the four request
+handlers, the expiry sweep, ``_healthz``, and the ``serve_forever`` status
+line — and is NEVER held across socket I/O or logging. Every handler
+builds its reply dict *inside* the critical section and sends it *after*
+release (``_handle_conn`` owns the ``send_msg``); ``_expire_loop`` and the
+handlers log after releasing. A heartbeat reply sent under the lease-table
+lock would serialize the whole control plane behind one slow peer's TCP
+window — the cross-module lock model keeps that shape a lint failure, not
+a code-review hope. The registry counter/gauge calls inside
+``_rebalance_locked`` do nest the registry's internal lock under ``_lock``
+(a ``coordinator._lock → registry._lock`` edge in ``ldt graph``); that
+order is acyclic program-wide because the registry never calls back out.
 """
 
 from __future__ import annotations

@@ -119,8 +119,12 @@ class RemoteLoader:
         self._hello_version = P.PROTOCOL_VERSION
         self._num_steps: Optional[int] = None
         # Set by the active iteration; test/ops hook: closing it simulates a
-        # connection drop and exercises the resume path.
+        # connection drop and exercises the resume path. Published by the
+        # receiver thread and read by the consumer's teardown — every
+        # access goes through _publish_conn/_close_conn under this lock
+        # (LDT1002: the handle swap and the closer's read must not tear).
         self._conn: Optional[socket.socket] = None
+        self._conn_lock = threading.Lock()
         # Resume cursor (contract: data/pipeline.py): _start_step rides the
         # next iteration's HELLO as start_step — the server slices its
         # (identical, deterministic) plan there, the same mechanism
@@ -137,10 +141,34 @@ class RemoteLoader:
         step = int(state.get("step", 0))
         if step < 0:
             raise ValueError(f"negative resume cursor: {step}")
-        self._start_step = step
+        # Resume cursor: loaded between iterations, while no receiver
+        # thread is live (the checkpoint-restore contract in
+        # data/pipeline.py) — happens-before the next __iter__ spawn.
+        self._start_step = step  # ldt: ignore[LDT1002] -- set while quiescent, before __iter__ spawns the receiver
         self._yielded = step
 
     # -- connection management --------------------------------------------
+
+    def _publish_conn(self, sock: Optional[socket.socket]) -> None:
+        """Expose (or retract) the active socket for a concurrent
+        :meth:`_close_conn` — the teardown hook that breaks a blocked
+        recv. One lock on both sides keeps the swap and the closer's read
+        from interleaving."""
+        with self._conn_lock:
+            self._conn = sock
+
+    def _close_conn(self) -> None:
+        """Close whatever socket is currently published. The close itself
+        runs OUTSIDE the lock — socket teardown is I/O, and holding a lock
+        across I/O is the exact shape LDT1001 exists to keep out of this
+        codebase."""
+        with self._conn_lock:
+            conn = self._conn
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _hello(self, start_step: int, probe: bool = False) -> dict:
         return P.hello(
@@ -216,7 +244,7 @@ class RemoteLoader:
                 # close() can break a handshake recv out of its full
                 # timeout (a half-dead server that accepts but never
                 # replies would otherwise pin teardown for timeout_s).
-                self._conn = sock
+                self._publish_conn(sock)
                 if stop.is_set():
                     raise ConnectionError("loader closed during connect")
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -251,7 +279,7 @@ class RemoteLoader:
                     f"client supports {P.MIN_PROTOCOL_VERSION}.."
                     f"{P.PROTOCOL_VERSION}"
                 )
-            self._num_steps = int(reply["num_steps"])
+            self._num_steps = int(reply["num_steps"])  # ldt: ignore[LDT1002] -- idempotent plan-length cache: every writer stores the same value for a given epoch
             # Streaming phase: no recv deadline. A slow step (cold
             # decode, read retries, busy shared pool) must NOT be
             # misread as a drop — a timeout here would reconnect and
@@ -278,10 +306,12 @@ class RemoteLoader:
         ``__iter__`` requests the new epoch's plan (step count may differ
         only through the plan cache, so invalidate it)."""
         if epoch != self.epoch:
-            self.epoch = epoch
-            self._num_steps = None
+            # Epoch rollover runs between epochs, while no receiver
+            # thread is live — happens-before the next __iter__ spawn.
+            self.epoch = epoch  # ldt: ignore[LDT1002] -- set while quiescent, before __iter__ spawns the receiver
+            self._num_steps = None  # ldt: ignore[LDT1002] -- set while quiescent, before __iter__ spawns the receiver
             # A new epoch's plan starts at its own step 0.
-            self._start_step = 0
+            self._start_step = 0  # ldt: ignore[LDT1002] -- set while quiescent, before __iter__ spawns the receiver
             self._yielded = 0
 
     def _release(self, batch) -> None:
@@ -301,7 +331,7 @@ class RemoteLoader:
         sock: Optional[socket.socket] = None
         try:
             sock, _ = self._connect(next_step, stop=stop)
-            self._conn = sock
+            self._publish_conn(sock)
             # Reusable receive buffer (FrameReader): every frame recv_into's
             # the same pages; decode_batch copies out (into pool leases)
             # before the next receive reuses them.
@@ -321,7 +351,7 @@ class RemoteLoader:
                     except OSError:
                         pass
                     sock, _ = self._connect(next_step, stop=stop)
-                    self._conn = sock
+                    self._publish_conn(sock)
                     reader = P.FrameReader(sock)
                     continue
                 if msg_type == P.MSG_BATCH:
@@ -373,7 +403,7 @@ class RemoteLoader:
         except BaseException as exc:  # surface to the consumer
             q.put(exc)
         finally:
-            self._conn = None
+            self._publish_conn(None)
             if sock is not None:
                 try:
                     sock.close()
@@ -415,14 +445,9 @@ class RemoteLoader:
                     self._release(host)
         finally:
             stop.set()
-            conn = self._conn
-            if conn is not None:
-                # recv_msg may be blocked on a healthy-but-idle socket;
-                # closing it unblocks the receiver thread immediately.
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+            # recv_msg may be blocked on a healthy-but-idle socket;
+            # closing it unblocks the receiver thread immediately.
+            self._close_conn()
             while receiver.is_alive():
                 try:
                     # Drained items are undelivered host batches — return

@@ -479,7 +479,7 @@ class FrameReader:
         if length > len(self._buf):
             # Grow geometrically: a few early resizes, then a stable page
             # set for the rest of the stream.
-            self._buf = bytearray(max(length, 2 * len(self._buf)))
+            self._buf = bytearray(max(length, 2 * len(self._buf)))  # ldt: ignore[LDT1002] -- per-connection reader owned by exactly one receiver thread; instances are never shared
         payload = memoryview(self._buf)[:length]
         self._recv_exact_into(payload, deadline)
         if msg_type == MSG_BATCH:

@@ -205,7 +205,7 @@ class _ClientSession:
                 s for s in range(start, len(plan))
                 if s % stripe_count == stripe_index
             ]
-            self.last_acked = start - 1
+            self.last_acked = start - 1  # ldt: ignore[LDT1002] -- initialized before _stream spawns the ack-reader; happens-before
             P.send_msg(
                 self.sock, P.MSG_HELLO_OK,
                 # Echo the NEGOTIATED version, not this build's ceiling: a
@@ -419,7 +419,11 @@ class _ClientSession:
             while not self._stop.is_set():
                 msg_type, msg = P.recv_msg(self.sock)
                 if msg_type == P.MSG_ACK:
-                    self.last_acked = max(self.last_acked, int(msg["step"]))
+                    # Sole streaming-phase writer; GIL-atomic int swap
+                    # read only by /healthz reporting.
+                    self.last_acked = max(  # ldt: ignore[LDT1002] -- monotonic cursor, single writer after handshake; torn reads impossible under the GIL
+                        self.last_acked, int(msg["step"])
+                    )
                     self.service.counters.gauge(
                         "last_acked", self.last_acked
                     )

@@ -29,7 +29,13 @@
 # losses equal to an uninterrupted control arm; a second trainer SIGTERMed
 # mid-epoch drains with an awaited emergency checkpoint and exit 0 while
 # its /metrics serves the ckpt_* series.
-# Stage 7 — the tier-1 verify command from ROADMAP.md, verbatim.
+# Stage 7 — the tier-1 verify command from ROADMAP.md, verbatim — run
+# under LDT_LOCK_SANITIZER=1: every threading.Lock/RLock the package
+# creates is wrapped to record actual acquisition orderings, and conftest
+# dumps the witness JSON on exit.
+# Stage 8 — `ldt check --lock-witness` against that witness: the runtime
+# evidence corroborates (or prunes) the static LDT1001 lock-order cycles,
+# and any NEW LDT10xx finding fails the build exactly like stage 1.
 set -e
 cd "$(dirname "$0")/.."
 
@@ -118,5 +124,15 @@ echo "== preemption smoke (SIGKILL resume fidelity + SIGTERM drain) =="
 # and the SIGTERM is the real k8s-eviction path asserted to exit 0.
 timeout -k 10 540 env JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/preempt_smoke.py
 
-echo "== tier-1 tests =="
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+echo "== tier-1 tests (lock sanitizer on) =="
+WITNESS=/tmp/_ldt_lock_witness.json
+rm -f "$WITNESS"
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu LDT_LOCK_SANITIZER=1 LDT_LOCK_WITNESS_PATH="$WITNESS" python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+echo "== lock-order witness cross-check =="
+# The instrumented run's observed acquisition orderings, fed back into the
+# static gate: a real lock-order cycle now carries a reproducing trace; a
+# statically-inferred cycle the run contradicts is marked witness_pruned.
+test -s "$WITNESS" || { echo "missing lock witness $WITNESS"; exit 1; }
+python scripts/ldt_check.py --lock-witness "$WITNESS"

@@ -49,6 +49,46 @@ def pytest_configure(config):
         "fast: sampler/format/pipeline invariants quick enough to gate "
         "every commit: pytest -m fast",
     )
+    # Runtime lock-order witness (LDT1001's evidence half): under
+    # LDT_LOCK_SANITIZER=1 every threading.Lock/RLock the package creates
+    # is wrapped to record actual acquisition orderings; unconfigure dumps
+    # the witness JSON for `ldt check --lock-witness`. Installed HERE —
+    # before collection imports any package module — so module-level locks
+    # (native/jpeg.py, data/buffers.py, obs/spans.py) are instrumented too.
+    if os.environ.get("LDT_LOCK_SANITIZER") == "1":
+        _load_lockorder().install()
+
+
+def pytest_unconfigure(config):
+    if os.environ.get("LDT_LOCK_SANITIZER") == "1":
+        # Dump unconditionally (not gated on installed()): whatever the
+        # suite recorded is the witness, even if a unit test toggled the
+        # shim along the way (they snapshot/restore, belt and braces).
+        lockorder = _load_lockorder()
+        path = lockorder.dump()
+        lockorder.uninstall()
+        sys.stderr.write(f"\n[lockorder] witness written to {path}\n")
+
+
+def _load_lockorder():
+    """Load ``utils/lockorder.py`` WITHOUT importing the package __init__
+    (which would create the module-level locks before the shim exists,
+    leaving them uninstrumented). Registered under the canonical dotted
+    name so a later in-test import shares the same recorder state."""
+    import importlib.util
+
+    name = "lance_distributed_training_tpu.utils.lockorder"
+    if name in sys.modules:
+        return sys.modules[name]
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "lance_distributed_training_tpu", "utils", "lockorder.py",
+    )
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
 
 
 def pytest_collection_modifyitems(items):

@@ -1,0 +1,12 @@
+"""Seeded fixture package for the LDT1001-1003 cross-module rules.
+
+Never imported — only parsed by the analyzer. The seeds (asserted exactly
+by ``tests/test_analysis.py``):
+
+* a lock-order cycle ``alpha._lock_a -> beta._lock_b -> alpha._lock_a``
+  split across two modules (LDT1001);
+* an unsynchronized ``Alpha.shared`` written on the worker thread and read
+  on the main thread (LDT1002), next to a properly-guarded negative
+  control (``Alpha.guarded``);
+* a protocol constant (``MSG_ORPHAN``) no dispatcher handles (LDT1003).
+"""

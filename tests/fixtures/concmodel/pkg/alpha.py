@@ -1,0 +1,46 @@
+"""Fixture: spawns a worker, seeds half the deadlock cycle and the race."""
+
+import threading
+
+from . import beta, protocol
+
+
+class Alpha:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._safe_lock = threading.Lock()
+        self.peer = beta.Beta(self)
+        self.shared = 0  # seeded LDT1002: worker writes, main reads, no lock
+        self.guarded = 0  # negative control: both sides under _safe_lock
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            with self._lock_a:
+                self.peer.poke()  # acquires beta._lock_b under _lock_a
+            self.shared = self.shared + 1  # the seeded unsynced write
+            with self._safe_lock:
+                self.guarded = self.guarded + 1
+
+    def pull(self):
+        with self._lock_a:
+            return 0
+
+    def snapshot(self):
+        return self.shared  # main-thread read of the worker-written attr
+
+    def snapshot_guarded(self):
+        with self._safe_lock:
+            return self.guarded
+
+
+def dispatch(msg_type, payload):
+    """The fixture's one dispatcher: PING and PONG have arms, MSG_ORPHAN
+    deliberately has none (and is in no vocabulary)."""
+    if msg_type == protocol.MSG_PING:
+        return "ping", payload
+    if msg_type == protocol.MSG_PONG:
+        return "pong", payload
+    raise ValueError(f"unhandled message {msg_type}")

@@ -1,0 +1,17 @@
+"""Fixture: the other half of the cross-module deadlock cycle."""
+
+import threading
+
+
+class Beta:
+    def __init__(self, alpha: "Alpha"):
+        self._lock_b = threading.Lock()
+        self.alpha = alpha
+
+    def poke(self):
+        with self._lock_b:
+            return 1
+
+    def kick(self):
+        with self._lock_b:
+            self.alpha.pull()  # acquires alpha._lock_a under _lock_b

@@ -1,0 +1,5 @@
+"""Fixture wire protocol — three constants, one deliberately orphaned."""
+
+MSG_PING = 1  # handled by alpha.dispatch
+MSG_PONG = 2  # handled by alpha.dispatch
+MSG_ORPHAN = 3  # seeded LDT1003 finding: in no dispatcher's vocabulary

@@ -860,15 +860,23 @@ def test_json_output_schema(tmp_path):
     rc = check_main(["--root", str(tmp_path), ".", "--json"], out=out)
     assert rc == 1
     data = json.loads(out.getvalue())
-    assert data["version"] == 1
+    assert data["version"] == 2
     assert data["clean"] is False
     assert isinstance(data["files_checked"], int)
     assert isinstance(data["grandfathered"], int)
+    # r9 additions: analysis wall time (the parse-once satellite's receipt)
+    # rides every JSON report.
+    assert isinstance(data["wall_time_ms"], (int, float))
+    assert isinstance(data["parse_ms"], (int, float))
+    assert data["wall_time_ms"] >= data["parse_ms"] >= 0
     (finding,) = data["findings"]
     assert set(finding) == {
-        "rule", "path", "line", "col", "message", "fingerprint"
+        "rule", "rule_family", "path", "line", "col", "message",
+        "fingerprint", "witness_pruned",
     }
     assert finding["rule"] == "LDT001"
+    assert finding["rule_family"] == "determinism"
+    assert finding["witness_pruned"] is False
     assert finding["path"] == "m.py"
     assert finding["line"] == 2
     assert isinstance(finding["fingerprint"], str) and finding["fingerprint"]
@@ -1016,3 +1024,710 @@ def test_repo_is_clean_under_ldt_check():
     out = io.StringIO()
     rc = check_main(["--root", str(REPO_ROOT)], out=out)
     assert rc == 0, f"ldt check found new violations:\n{out.getvalue()}"
+
+
+# -- LDT1001 lock-order cycles (cross-module concurrency model) ---------------
+
+
+FIXTURE_ROOT = REPO_ROOT / "tests" / "fixtures" / "concmodel"
+
+
+def _concmodel_config(**kwargs):
+    from lance_distributed_training_tpu.analysis import CheckConfig
+
+    kwargs.setdefault("paths", ["pkg"])
+    kwargs.setdefault("queue_paths", ["*"])
+    kwargs.setdefault("protocol_module", "pkg/protocol.py")
+    kwargs.setdefault("dispatch", {"pkg/alpha.py": ["MSG_PING", "MSG_PONG"]})
+    return CheckConfig(**kwargs)
+
+
+def test_ldt1001_flags_cross_module_cycle(tmp_path):
+    findings = run_rules(tmp_path, {
+        "a.py": """\
+            import threading
+
+            from b import B
+
+            class A:
+                def __init__(self, b: "B"):
+                    self._la = threading.Lock()
+                    self.b = b
+
+                def one(self):
+                    with self._la:
+                        self.b.two()
+
+                def entered(self):
+                    with self._la:
+                        return 1
+        """,
+        "b.py": """\
+            import threading
+
+            class B:
+                def __init__(self, a: "A"):
+                    self._lb = threading.Lock()
+                    self.a = a
+
+                def two(self):
+                    with self._lb:
+                        return 1
+
+                def back(self):
+                    with self._lb:
+                        self.a.entered()
+        """,
+    })
+    cycles = [f for f in findings if f.rule == "LDT1001"]
+    assert len(cycles) == 1, [f.message for f in findings]
+    assert "lock-order cycle" in cycles[0].message
+    assert "_la" in cycles[0].message and "_lb" in cycles[0].message
+
+
+def test_ldt1001_consistent_order_is_clean(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import threading
+
+        class M:
+            def __init__(self):
+                self._outer = threading.Lock()
+                self._inner = threading.Lock()
+
+            def one(self):
+                with self._outer:
+                    with self._inner:
+                        return 1
+
+            def two(self):
+                with self._outer:
+                    with self._inner:
+                        return 2
+    """})
+    assert [f for f in findings if f.rule == "LDT1001"] == []
+
+
+def test_ldt1001_multi_item_with_orders_left_to_right(tmp_path):
+    # `with a, b:` IS `with a: with b:` — inverted multi-item withs are
+    # the same textbook deadlock and must not hide in one statement.
+    findings = run_rules(tmp_path, {"m.py": """\
+        import threading
+
+        class M:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a, self._b:
+                    return 1
+
+            def two(self):
+                with self._b, self._a:
+                    return 2
+    """})
+    cycles = [f for f in findings if f.rule == "LDT1001"]
+    assert len(cycles) == 1, [f.message for f in findings]
+    assert "lock-order cycle" in cycles[0].message
+
+
+def test_ldt1001_flags_nonreentrant_self_deadlock(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    return 1
+    """})
+    selfs = [f for f in findings if f.rule == "LDT1001"]
+    assert len(selfs) == 1
+    assert "acquired while already held" in selfs[0].message
+
+
+def test_ldt1001_rlock_reentry_is_clean(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    return 1
+    """})
+    assert [f for f in findings if f.rule == "LDT1001"] == []
+
+
+# -- LDT1002 unsynchronized shared state --------------------------------------
+
+
+def test_ldt1002_flags_cross_thread_unlocked_attr(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.value = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self.value = self.value + 1
+
+            def read(self):
+                return self.value
+    """})
+    races = [f for f in findings if f.rule == "LDT1002"]
+    assert len(races) == 1, [f.message for f in findings]
+    assert "Worker.value" in races[0].message
+    assert races[0].line == 11  # the write site, not the read
+
+
+def test_ldt1002_common_lock_is_clean(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.value = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                with self._lock:
+                    self.value = self.value + 1
+
+            def read(self):
+                with self._lock:
+                    return self.value
+    """})
+    assert [f for f in findings if f.rule == "LDT1002"] == []
+
+
+def test_ldt1002_locked_suffix_convention_is_computed(tmp_path):
+    # _bump_locked never takes the lock itself; every call site holds it.
+    # The held-at-entry fixpoint must prove that instead of trusting names.
+    findings = run_rules(tmp_path, {"m.py": """\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.value = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def _bump_locked(self):
+                self.value = self.value + 1
+
+            def read(self):
+                with self._lock:
+                    return self.value
+    """})
+    assert [f for f in findings if f.rule == "LDT1002"] == []
+
+
+def test_ldt1002_threadsafe_type_handoff_is_clean(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.done = threading.Event()
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self.done = threading.Event()  # reassigned, but an Event
+
+            def wait(self):
+                return self.done.wait(1.0)
+    """})
+    assert [f for f in findings if f.rule == "LDT1002"] == []
+
+
+def test_ldt1002_prespawn_publication_is_clean(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.ready = 0
+
+            def start(self):
+                self.ready = 1
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                return self.ready
+    """})
+    assert [f for f in findings if f.rule == "LDT1002"] == []
+
+
+def test_ldt10xx_ignore_requires_reason(tmp_path):
+    racy = """\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.value = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self.value = 1{comment}
+
+            def read(self):
+                return self.value
+    """
+    # Bare ignore: stays live (the gate still fails).
+    findings = run_rules(
+        tmp_path / "bare",
+        {"m.py": racy.format(comment="  # ldt: ignore[LDT1002]")},
+    )
+    assert [f.rule for f in findings if f.rule == "LDT1002"] == ["LDT1002"]
+    # Suppress-all bare ignore: also stays live for LDT10xx.
+    findings = run_rules(
+        tmp_path / "all",
+        {"m.py": racy.format(comment="  # ldt: ignore")},
+    )
+    assert [f.rule for f in findings if f.rule == "LDT1002"] == ["LDT1002"]
+    # Reasoned ignore: suppressed.
+    findings = run_rules(
+        tmp_path / "reasoned",
+        {"m.py": racy.format(
+            comment="  # ldt: ignore[LDT1002] -- benign monotonic flag"
+        )},
+    )
+    assert [f for f in findings if f.rule == "LDT1002"] == []
+    # Non-10xx rules keep the old contract: bare ignores still work.
+    findings = run_rules(
+        tmp_path / "old",
+        {"m.py": "import numpy as np\n"
+                 "x = np.random.permutation(4)  # ldt: ignore[LDT001]\n"},
+    )
+    assert findings == []
+
+
+# -- LDT1003 dispatcher exhaustiveness ----------------------------------------
+
+
+_PROTO_AB = "MSG_A = 1\nMSG_B = 2\n"
+
+
+def test_ldt1003_flags_missing_dispatch_arm(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {
+            "proto.py": _PROTO_AB,
+            "d.py": """\
+                import proto
+
+                def handle(msg_type):
+                    if msg_type == proto.MSG_A:
+                        return "a"
+                    raise ValueError(msg_type)
+            """,
+        },
+        protocol_module="proto.py",
+        dispatch={"d.py": ["MSG_A", "MSG_B"]},
+    )
+    hits = [f for f in findings if f.rule == "LDT1003"]
+    assert len(hits) == 1
+    assert "MSG_B" in hits[0].message and hits[0].path == "d.py"
+
+
+def test_ldt1003_flags_orphan_constant_at_definition(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {
+            "proto.py": _PROTO_AB,
+            "d.py": """\
+                import proto
+
+                def handle(msg_type):
+                    if msg_type == proto.MSG_A:
+                        return "a"
+                    raise ValueError(msg_type)
+            """,
+        },
+        protocol_module="proto.py",
+        dispatch={"d.py": ["MSG_A"]},
+    )
+    hits = [f for f in findings if f.rule == "LDT1003"]
+    assert len(hits) == 1
+    assert "MSG_B" in hits[0].message
+    assert hits[0].path == "proto.py" and hits[0].line == 2
+
+
+def test_ldt1003_flags_config_drift(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {
+            "proto.py": "MSG_A = 1\n",
+            "d.py": """\
+                import proto
+
+                def handle(msg_type):
+                    if msg_type == proto.MSG_A:
+                        return "a"
+            """,
+        },
+        protocol_module="proto.py",
+        dispatch={"d.py": ["MSG_A", "MSG_NOPE"]},
+    )
+    hits = [f for f in findings if f.rule == "LDT1003"]
+    assert len(hits) == 1
+    assert "MSG_NOPE" in hits[0].message and "drift" in hits[0].message
+
+
+def test_ldt1003_dict_dispatch_and_compare_are_coverage(tmp_path):
+    findings = run_rules(
+        tmp_path,
+        {
+            "proto.py": _PROTO_AB + "MSG_C = 3\n",
+            "d.py": """\
+                import proto
+
+                def handle(msg_type, req):
+                    handler = {
+                        proto.MSG_A: handle_a,
+                        proto.MSG_B: handle_b,
+                    }.get(msg_type)
+                    if msg_type == proto.MSG_C:
+                        raise ValueError("explicitly rejected")
+                    return handler(req)
+
+                def handle_a(req):
+                    return "a"
+
+                def handle_b(req):
+                    return "b"
+            """,
+        },
+        protocol_module="proto.py",
+        dispatch={"d.py": ["MSG_A", "MSG_B", "MSG_C"]},
+    )
+    assert [f for f in findings if f.rule == "LDT1003"] == []
+
+
+def test_ldt1003_inert_without_scanned_dispatchers(tmp_path):
+    # A fixture tree whose configured dispatcher modules are not in the
+    # scan (the LDT501 fixtures, most third-party layouts) must not fail
+    # the orphan-constant check.
+    findings = run_rules(
+        tmp_path,
+        {"proto.py": "MSG_LONELY = 9\n"},
+        protocol_module="proto.py",
+        dispatch={"not/scanned.py": ["MSG_LONELY"]},
+    )
+    assert [f for f in findings if f.rule == "LDT1003"] == []
+
+
+# -- the seeded fixture package ----------------------------------------------
+
+
+def test_fixture_package_yields_exactly_the_planted_findings():
+    from lance_distributed_training_tpu.analysis import analyze
+
+    findings = analyze(str(FIXTURE_ROOT), _concmodel_config())
+    assert [(f.rule, f.path) for f in findings] == [
+        ("LDT1001", "pkg/alpha.py"),
+        ("LDT1002", "pkg/alpha.py"),
+        ("LDT1003", "pkg/protocol.py"),
+    ], [f.message for f in findings]
+    by_rule = {f.rule: f for f in findings}
+    assert "Alpha.shared" in by_rule["LDT1002"].message
+    assert "MSG_ORPHAN" in by_rule["LDT1003"].message
+    assert "_lock_a" in by_rule["LDT1001"].message
+
+
+def _lock_site(relpath: str, needle: str, absolute: bool = False) -> str:
+    path = FIXTURE_ROOT / relpath
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        if needle in line:
+            prefix = str(path) if absolute else relpath
+            return f"{prefix}:{i}"
+    raise AssertionError(f"{needle} not in {relpath}")
+
+
+def test_witness_prunes_unobserved_cycle_edge():
+    from lance_distributed_training_tpu.analysis import analyze
+
+    site_a = _lock_site("pkg/alpha.py", "_lock_a = threading.Lock()")
+    site_b = _lock_site("pkg/beta.py", "_lock_b = threading.Lock()")
+    config = _concmodel_config()
+    # Both locks exercised, only the a->b ordering ever observed: the
+    # static b->a edge (Beta.kick is dead code at runtime) is
+    # contradicted, so the cycle prunes.
+    config.lock_witness = {
+        "edges": {(site_a, site_b)},
+        "acquired": {site_a: 5, site_b: 5},
+    }
+    findings = analyze(str(FIXTURE_ROOT), config)
+    cycle = next(f for f in findings if f.rule == "LDT1001")
+    assert cycle.witness_pruned is True
+    assert "witness_pruned" in cycle.message
+
+
+def test_witness_corroborates_observed_cycle():
+    from lance_distributed_training_tpu.analysis import analyze
+
+    site_a = _lock_site("pkg/alpha.py", "_lock_a = threading.Lock()")
+    site_b = _lock_site("pkg/beta.py", "_lock_b = threading.Lock()")
+    config = _concmodel_config()
+    config.lock_witness = {
+        "edges": {(site_a, site_b), (site_b, site_a)},
+        "acquired": {site_a: 5, site_b: 5},
+    }
+    findings = analyze(str(FIXTURE_ROOT), config)
+    cycle = next(f for f in findings if f.rule == "LDT1001")
+    assert cycle.witness_pruned is False
+    assert "observed at runtime" in cycle.message
+
+
+def test_witness_without_exercise_does_not_prune():
+    from lance_distributed_training_tpu.analysis import analyze
+
+    site_a = _lock_site("pkg/alpha.py", "_lock_a = threading.Lock()")
+    config = _concmodel_config()
+    # _lock_b never acquired at runtime: absence of the b->a edge proves
+    # nothing, the cycle must stay live.
+    config.lock_witness = {"edges": set(), "acquired": {site_a: 5}}
+    findings = analyze(str(FIXTURE_ROOT), config)
+    cycle = next(f for f in findings if f.rule == "LDT1001")
+    assert cycle.witness_pruned is False
+
+
+def test_check_main_lock_witness_end_to_end(tmp_path):
+    pytest.importorskip("tomli")
+    witness = {
+        "version": 1,
+        "edges": [{
+            "src": _lock_site(
+                "pkg/alpha.py", "_lock_a = threading.Lock()", absolute=True
+            ),
+            "dst": _lock_site(
+                "pkg/beta.py", "_lock_b = threading.Lock()", absolute=True
+            ),
+            "count": 4,
+        }],
+        "acquired": {
+            _lock_site("pkg/alpha.py", "_lock_a = threading.Lock()",
+                       absolute=True): 4,
+            _lock_site("pkg/beta.py", "_lock_b = threading.Lock()",
+                       absolute=True): 4,
+        },
+    }
+    wpath = tmp_path / "witness.json"
+    wpath.write_text(json.dumps(witness))
+    out = io.StringIO()
+    rc = check_main(
+        ["--root", str(FIXTURE_ROOT), "--json", "--no-baseline",
+         "--lock-witness", str(wpath)],
+        out=out,
+    )
+    assert rc == 1  # the LDT1002/LDT1003 seeds still fail the gate
+    data = json.loads(out.getvalue())
+    cycle = next(f for f in data["findings"] if f["rule"] == "LDT1001")
+    assert cycle["witness_pruned"] is True
+    assert cycle["rule_family"] == "lock-order"
+    race = next(f for f in data["findings"] if f["rule"] == "LDT1002")
+    assert race["witness_pruned"] is False
+
+
+# -- ldt graph ----------------------------------------------------------------
+
+
+def test_graph_dot_smoke():
+    from lance_distributed_training_tpu.analysis import graph_main
+
+    out = io.StringIO()
+    rc = graph_main(["--root", str(FIXTURE_ROOT), "pkg", "--dot"], out=out)
+    assert rc == 0
+    dot = out.getvalue()
+    assert dot.startswith("digraph ldt_concurrency")
+    assert '"thread:pkg.alpha.Alpha._loop"' in dot
+    assert '"lock:pkg.alpha.Alpha._lock_a"' in dot
+    assert '"lock:pkg.beta.Beta._lock_b"' in dot
+    # Both cycle edges render.
+    assert ('"lock:pkg.alpha.Alpha._lock_a" -> '
+            '"lock:pkg.beta.Beta._lock_b"') in dot
+    assert ('"lock:pkg.beta.Beta._lock_b" -> '
+            '"lock:pkg.alpha.Alpha._lock_a"') in dot
+
+
+def test_graph_text_smoke():
+    from lance_distributed_training_tpu.analysis import graph_main
+
+    out = io.StringIO()
+    rc = graph_main(["--root", str(FIXTURE_ROOT), "pkg"], out=out)
+    assert rc == 0
+    text = out.getvalue()
+    assert "thread Alpha._loop" in text
+    assert "lock-order cycles: 1" in text
+
+
+def test_graph_cli_dispatch():
+    import lance_distributed_training_tpu.cli as cli
+
+    rc = cli.main(["graph", "--root", str(FIXTURE_ROOT), "pkg"])
+    assert rc == 0
+
+
+# -- runtime lock sanitizer (utils/lockorder.py) ------------------------------
+
+
+@pytest.fixture()
+def lockorder_sandbox():
+    """Snapshot/restore the recorder around tests that install, reset, or
+    pollute it: a sanitizer-enabled session (``LDT_LOCK_SANITIZER=1``
+    tier-1 run) collects its witness ACROSS the suite, and these unit
+    tests must not wipe it. Assertions inside stay subset-based — package
+    daemon threads from earlier tests may legitimately record edges
+    concurrently."""
+    from lance_distributed_training_tpu.utils import lockorder
+
+    saved = lockorder.snapshot()
+    lockorder.uninstall()
+    lockorder.reset()
+    try:
+        yield lockorder
+    finally:
+        lockorder.restore(saved)
+
+
+def test_lockorder_records_nesting_edges(lockorder_sandbox):
+    lockorder = lockorder_sandbox
+    a = lockorder.InstrumentedLock("x.py:1")
+    b = lockorder.InstrumentedLock("x.py:2")
+    with a:
+        with b:
+            pass
+    mine = {e: n for e, n in lockorder.edges().items()
+            if e[0].startswith("x.py")}
+    assert mine == {("x.py:1", "x.py:2"): 1}
+    with b:
+        with a:
+            pass
+    mine = {e for e in lockorder.edges() if e[0].startswith("x.py")}
+    assert mine == {("x.py:1", "x.py:2"), ("x.py:2", "x.py:1")}
+
+
+def test_lockorder_rlock_reentry_records_no_self_edge(lockorder_sandbox):
+    lockorder = lockorder_sandbox
+    r = lockorder.InstrumentedLock("x.py:9", reentrant=True)
+    with r:
+        with r:
+            pass
+    assert all(
+        src != dst for src, dst in lockorder.edges()
+        if src.startswith("x.py")
+    )
+
+
+def test_lockorder_install_scopes_and_restores(lockorder_sandbox):
+    import threading
+
+    lockorder = lockorder_sandbox
+    real_lock_type = type(threading.Lock())
+    lockorder.install(scope=[str(REPO_ROOT / "tests")])
+    try:
+        assert lockorder.installed()
+        lk = threading.Lock()  # created in tests/: instrumented
+        assert isinstance(lk, lockorder.InstrumentedLock)
+        assert "test_analysis.py" in lk.site
+    finally:
+        lockorder.uninstall()
+    assert not lockorder.installed()
+    assert isinstance(threading.Lock(), real_lock_type)
+
+
+def test_lockorder_dump_roundtrips_through_witness_loader(
+    lockorder_sandbox, tmp_path
+):
+    from lance_distributed_training_tpu.analysis.cli import load_lock_witness
+
+    lockorder = lockorder_sandbox
+    site_a = str(tmp_path / "pkg" / "a.py") + ":10"
+    site_b = str(tmp_path / "pkg" / "b.py") + ":20"
+    a = lockorder.InstrumentedLock(site_a)
+    b = lockorder.InstrumentedLock(site_b)
+    with a:
+        with b:
+            pass
+    path = lockorder.dump(str(tmp_path / "witness.json"))
+    witness = load_lock_witness(path, str(tmp_path))
+    assert ("pkg/a.py:10", "pkg/b.py:20") in witness["edges"]
+    assert witness["acquired"].get("pkg/a.py:10") == 1
+    assert witness["acquired"].get("pkg/b.py:20") == 1
+
+
+# -- parse cache --------------------------------------------------------------
+
+
+def test_parse_cache_invalidates_on_file_change(tmp_path):
+    from lance_distributed_training_tpu.analysis import CheckConfig, analyze
+
+    config = CheckConfig(paths=["."], queue_paths=["*"])
+    (tmp_path / "m.py").write_text(VIOLATION)
+    assert rule_ids(analyze(str(tmp_path), config)) == ["LDT001"]
+    (tmp_path / "m.py").write_text("x = 1\n")
+    assert analyze(str(tmp_path), config) == []
+
+
+def test_repo_program_model_sees_the_known_topology():
+    """The cross-module model on the real tree: the known thread entry
+    points and locks resolve, and the lease-table → registry nesting is
+    the edge the coordinator docstring documents."""
+    from lance_distributed_training_tpu.analysis import (
+        build_program,
+        load_config,
+    )
+    from lance_distributed_training_tpu.analysis.core import analyze_project
+
+    root = str(REPO_ROOT)
+    config = load_config(root)
+    _findings, modules, _n = analyze_project(root, config)
+    program = build_program(modules, config)
+    targets = {t for t, _m, _n in program.spawn_sites if t is not None}
+    for expected in (
+        "lance_distributed_training_tpu.fleet.coordinator."
+        "Coordinator._expire_loop",
+        "lance_distributed_training_tpu.service.client."
+        "RemoteLoader._receive",
+        "lance_distributed_training_tpu.fleet.balancer._StripeRound._pump",
+        "lance_distributed_training_tpu.fleet.agent.FleetAgent._run",
+    ):
+        assert expected in targets, sorted(targets)
+    assert (
+        "lance_distributed_training_tpu.fleet.coordinator.Coordinator._lock"
+        in program.locks
+    )
+    edges = {(e.src.rsplit(".", 1)[-1], e.dst.rsplit(".", 1)[-1])
+             for e in program.lock_edges}
+    assert ("_lock", "_lock") in edges  # coordinator._lock -> registry._lock
+    assert program.lock_cycles() == []
