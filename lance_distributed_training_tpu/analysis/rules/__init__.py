@@ -21,4 +21,5 @@ from . import (  # noqa: F401  (import for registration side effect)
     protocol,
     resources,
     sharedstate,
+    tunables,
 )

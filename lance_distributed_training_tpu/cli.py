@@ -140,6 +140,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "global batch with a synchronous device_put on the "
                         "consumer thread (pre-r7 control arm; batches stay "
                         "bit-identical, H2D lands inside loader stall)")
+    p.add_argument("--no_autotune", action="store_true",
+                   help="disable the closed-loop pipeline autotuner (tune/) "
+                        "— run the exact fixed-knob configuration (workers/"
+                        "prefetch/pool/ring/stripes as passed); the control "
+                        "arm for benchmarking and bisection")
+    p.add_argument("--autotune_interval_s", type=float, default=1.0,
+                   help="autotune controller tick period (decisions also "
+                        "respect a policy cooldown between actuations)")
     p.add_argument("--data_echo", type=int, default=1,
                    help=">1: run N train steps per host batch with fresh "
                         "on-device augmentation each echo (data echoing) — "
@@ -335,7 +343,69 @@ def build_coordinator_parser() -> argparse.ArgumentParser:
                         "this port (0 = ephemeral; default off)")
     p.add_argument("--metrics_host", type=str, default="127.0.0.1",
                    help="exporter bind address (default loopback)")
+    p.add_argument("--scale_up_stall_pct", type=float, default=50.0,
+                   help="a member heartbeat reporting windowed stall above "
+                        "this flips the fleet recommendation to scale_up "
+                        "(/healthz, fleet_scale_recommendation gauge, "
+                        "`ldt fleet recommend`)")
+    p.add_argument("--scale_down_stall_pct", type=float, default=5.0,
+                   help="every member below this (with clients attached, "
+                        ">1 members) marks the fleet a drain candidate")
     return p
+
+
+def build_fleet_parser() -> argparse.ArgumentParser:
+    """``ldt fleet`` — operator queries against a running coordinator."""
+    p = argparse.ArgumentParser(
+        prog="ldt fleet",
+        description="Query a running `ldt coordinator`: membership, "
+                    "per-member heartbeat pressure, and the scale "
+                    "recommendation the autotune fleet half derives",
+    )
+    p.add_argument("action", choices=["recommend"],
+                   help="recommend: print the member table with each "
+                        "member's windowed stall pressure and the "
+                        "coordinator's scale-up/ok/drain recommendation")
+    p.add_argument("--coordinator", type=str, required=True,
+                   metavar="HOST:PORT")
+    p.add_argument("--timeout_s", type=float, default=10.0)
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print the raw RESOLVE payload as JSON (scripting)")
+    return p
+
+
+def fleet_main(argv=None) -> int:
+    """``fleet`` subcommand body. Exit status encodes the recommendation
+    for scripting: 0 = ok/drain_candidate, 3 = scale_up (so an operator
+    cron can `ldt fleet recommend … || page`)."""
+    import json
+
+    args = build_fleet_parser().parse_args(argv)
+    from .fleet.balancer import resolve_fleet
+
+    payload = resolve_fleet(args.coordinator, timeout_s=args.timeout_s)
+    recommendation = payload.get("recommendation") or {"action": "ok"}
+    if args.as_json:
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        print(
+            f"generation {payload.get('generation')}, "
+            f"{payload.get('stripe_count')} members"
+        )
+        for m in payload.get("members", []):
+            pressure = m.get("pressure") or {}
+            print(
+                f"  {m.get('server_id')} @ {m.get('addr')} "
+                f"stripe {m.get('stripe_index')} "
+                f"stall {pressure.get('stall_pct', '-')}% "
+                f"clients {pressure.get('active_clients', '-')} "
+                f"(heartbeat {m.get('heartbeat_age_s')}s ago)"
+            )
+        print(
+            f"recommendation: {recommendation.get('action')} — "
+            f"{recommendation.get('reason', '')}"
+        )
+    return 3 if recommendation.get("action") == "scale_up" else 0
 
 
 def coordinator_main(argv=None) -> dict:
@@ -352,6 +422,8 @@ def coordinator_main(argv=None) -> dict:
         log_every_s=args.log_every_s,
         metrics_port=args.metrics_port,
         metrics_host=args.metrics_host,
+        scale_up_stall_pct=args.scale_up_stall_pct,
+        scale_down_stall_pct=args.scale_down_stall_pct,
     ))
     coordinator.serve_forever()
     return coordinator.registry.snapshot()
@@ -412,6 +484,10 @@ def main(argv=None) -> dict:
         # The fleet control plane: membership + shard leases for N
         # serve-data members (README "Fleet").
         return coordinator_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # Operator queries against a running coordinator (pressure table +
+        # scale recommendation). Returns an int exit status: 3 = scale_up.
+        return fleet_main(argv[1:])
     if argv and argv[0] == "check":
         # The static-analysis gate: returns an int exit status (0 = clean /
         # no new findings), not a metrics dict.
@@ -527,6 +603,8 @@ def main(argv=None) -> dict:
         producer_threads=args.producer_threads,
         global_batch=not args.no_global_batch,
         placement_depth=args.placement_depth,
+        autotune=not args.no_autotune,
+        autotune_interval_s=args.autotune_interval_s,
         data_echo=args.data_echo,
         device_cache=args.device_cache,
         device_cache_gb=args.device_cache_gb,

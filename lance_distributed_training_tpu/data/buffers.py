@@ -207,6 +207,34 @@ class BufferPool:
             return 0
         return sum(self.release(v) for v in list(batch.values()))
 
+    def set_budget(self, max_free_per_key: int) -> int:
+        """Autotune actuator (tune/): resize the recycled-page budget, live.
+        Growing lets more warm pages survive between batches (the hit-rate
+        lever); shrinking trims every free list to the new cap immediately
+        (counted as evictions) — outstanding leases are untouched, so no
+        in-flight batch ever loses its page."""
+        cap = max(0, int(max_free_per_key))
+        with self._lock:
+            self.max_free_per_key = cap
+            for key, free in self._free.items():
+                if len(free) > cap:
+                    self._evicts.inc(len(free) - cap)
+                    del free[cap:]
+        return cap
+
+    def tunables(self):
+        """Autotune registration surface: the per-(shape, dtype) free-page
+        budget."""
+        from ..tune.tunable import Tunable
+
+        return [Tunable(
+            "bufpool_pages",
+            lambda: self.max_free_per_key,
+            self.set_budget,
+            lo=2, hi=64,
+            doc="recycled pages kept warm per (shape, dtype) key",
+        )]
+
     def sweep(self) -> None:
         """Run one pending→free sweep now. The sweep normally rides every
         ``lease``/``release``; the placement plane's release-at-dispatch

@@ -63,6 +63,7 @@ import pyarrow as pa
 from ..obs.lineage import make_lineage, observe_local_lineage
 from ..obs.registry import default_registry
 from ..obs.spans import span
+from ..tune.tunable import AdjustableQueue, Tunable, _LiveQueues
 from .format import Dataset
 from .samplers import (
     Plan,
@@ -178,6 +179,27 @@ class DataPipeline:
         # telemetry lines up with the uninterrupted run's).
         self._start_step = 0
         self._yielded = 0
+        # Autotune surface (tune/): the live prefetch queues of the current
+        # iteration, so set_prefetch() can move the bound mid-epoch.
+        self._live = _LiveQueues()
+
+    def set_prefetch(self, depth: int) -> int:
+        """Autotune actuator: move the prefetch bound, live. Takes effect
+        immediately on the current iteration's queue(s) (growing wakes a
+        blocked producer; shrinking lets the backlog drain — batches are
+        never dropped or reordered) and persists for later iterations."""
+        depth = max(1, int(depth))
+        self.prefetch = depth  # ldt: ignore[LDT1002] -- atomic int swap; readers take any recent value
+        self._live.resize_total(depth)
+        return depth
+
+    def tunables(self):
+        """Autotune registration surface (tune/): the prefetch depth."""
+        return [Tunable(
+            "prefetch", lambda: self.prefetch, self.set_prefetch,
+            lo=1, hi=16,
+            doc="decoded host batches buffered ahead of the consumer",
+        )]
 
     def state_dict(self) -> dict:
         return {"step": int(self._yielded)}
@@ -273,7 +295,8 @@ class DataPipeline:
                     "apply.",
                     stacklevel=2,
                 )
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        q: "queue.Queue" = AdjustableQueue(self.prefetch)
+        self._live.install([q])
         stop = threading.Event()
         base = self._start_step
         self._yielded = base
@@ -315,6 +338,7 @@ class DataPipeline:
                     self._release_host(host)
         finally:
             stop.set()
+            self._live.clear()
             # Drain so the producer's blocked put() can observe the stop flag
             # (releasing drained batches' pool leases as they go by).
             while producer.is_alive():
@@ -342,7 +366,8 @@ class DataPipeline:
         consumer still yields in plan order."""
         n = self.producers
         per = max(1, -(-max(self.prefetch, n) // n))
-        queues = [queue.Queue(maxsize=per) for _ in range(n)]
+        queues = [AdjustableQueue(per) for _ in range(n)]
+        self._live.install(queues)
         stop = threading.Event()
         base = self._start_step
         self._yielded = base
@@ -410,6 +435,7 @@ class DataPipeline:
                     self._release_host(batch)
         finally:
             stop.set()
+            self._live.clear()
             # Drain so blocked put()s can observe the stop flag (releasing
             # drained host batches' pool leases; device batches were
             # released in their producer already).
@@ -589,6 +615,26 @@ class MapStylePipeline:
         )
         self._start_step = 0
         self._yielded = 0
+        # The per-epoch inner DataPipeline currently iterating, so
+        # set_prefetch reaches its live queue (None between epochs).
+        self._live_pipe: Optional[DataPipeline] = None
+
+    def set_prefetch(self, depth: int) -> int:
+        """Autotune actuator — mirrors :meth:`DataPipeline.set_prefetch`,
+        forwarded to the epoch's live inner pipeline when one is up."""
+        depth = max(1, int(depth))
+        self.prefetch = depth  # ldt: ignore[LDT1002] -- atomic int swap; readers take any recent value
+        pipe = self._live_pipe
+        if pipe is not None:
+            pipe.set_prefetch(depth)
+        return depth
+
+    def tunables(self):
+        return [Tunable(
+            "prefetch", lambda: self.prefetch, self.set_prefetch,
+            lo=1, hi=16,
+            doc="decoded host batches buffered ahead of the consumer",
+        )]
 
     def set_epoch(self, epoch: int) -> None:
         if epoch != self.epoch:
@@ -649,9 +695,13 @@ class MapStylePipeline:
         # inner single-shot pipeline just starts at the same offset.
         pipe.load_state_dict({"step": self._start_step})
         self._yielded = self._start_step
-        for batch in pipe:
-            self._yielded += 1
-            yield batch
+        self._live_pipe = pipe  # ldt: ignore[LDT1002] -- handle publish; set_prefetch tolerates either epoch's pipe
+        try:
+            for batch in pipe:
+                self._yielded += 1
+                yield batch
+        finally:
+            self._live_pipe = None
 
 
 def make_map_style_pipeline(dataset: Dataset, *args, **kwargs) -> MapStylePipeline:

@@ -66,6 +66,7 @@ from ..parallel._compat import (
     make_array_from_process_local_data,
     make_array_from_single_device_arrays,
 )
+from ..tune.tunable import AdjustableQueue, Tunable, _LiveQueues
 from ..utils.metrics import ServiceCounters
 
 __all__ = ["PlacementPlane", "PlacedLoader"]
@@ -115,6 +116,26 @@ class PlacementPlane:
         # ndim → (NamedSharding, process_count): built once per rank, not
         # per leaf per batch — this runs on the hot placement thread.
         self._shardings: dict = {}
+        # Autotune surface: the live ring queue of the current iteration.
+        self._live = _LiveQueues()
+
+    def set_ring_depth(self, depth: int) -> int:
+        """Autotune actuator: move the device-resident ring bound, live.
+        Each extra slot pins one more global batch in HBM, so the tunable's
+        ``hi`` stays small; shrinking drains through the consumer (device
+        batches are never dropped — they were already transferred)."""
+        depth = max(1, int(depth))
+        self.depth = depth  # ldt: ignore[LDT1002] -- atomic int swap; readers take any recent value
+        self._live.resize_total(depth)
+        return depth
+
+    def tunables(self):
+        """Autotune registration surface: the H2D ring depth."""
+        return [Tunable(
+            "ring_depth", lambda: self.depth, self.set_ring_depth,
+            lo=1, hi=8,
+            doc="device-resident global batches kept ahead of the step",
+        )]
 
     # -- single-batch placement --------------------------------------------
 
@@ -222,7 +243,8 @@ class PlacementPlane:
         inner iterator is closed from the placement thread so upstream
         producer threads observe their stop flags.
         """
-        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        q: "queue.Queue" = AdjustableQueue(self.depth)
+        self._live.install([q])
         stop = threading.Event()
 
         def produce() -> None:
@@ -268,6 +290,7 @@ class PlacementPlane:
                 yield item
         finally:
             stop.set()
+            self._live.clear()
             # Drain so a blocked put() can observe the stop flag. Drained
             # items are device batches (host leases already released at
             # dispatch) — dropping them frees HBM via ordinary GC.
@@ -333,6 +356,16 @@ class PlacedLoader:
             inner_load(state)
         self._start = int(state.get("step", 0))
         self._yielded = self._start
+
+    def tunables(self):
+        """Autotune registration surface: the plane's ring depth plus
+        whatever knobs the wrapped loader exposes (prefetch, stripe
+        width) — the trainer collects from the outermost loader only."""
+        out = list(self.plane.tunables())
+        inner = getattr(self.inner, "tunables", None)
+        if inner is not None:
+            out.extend(inner())
+        return out
 
     @property
     def counters(self):

@@ -38,6 +38,8 @@ When to use which decode parallelism:
 from __future__ import annotations
 
 import multiprocessing as mp
+import os
+import threading
 import time
 import weakref
 from collections import deque
@@ -160,13 +162,54 @@ def _run_item(item):
     return ("raw", batch)
 
 
-def _teardown_pool(executor, ring, num_workers: int) -> None:
+class _PoolState:
+    """The mutable teardown target shared by :meth:`WorkerPool.shutdown`,
+    :meth:`WorkerPool.resize`, and the GC-time finalizer. The finalizer must
+    NOT close over the pool (that would pin it alive forever) and must NOT
+    bind a fixed executor (``resize`` swaps executors) — so everything
+    teardown needs lives here, behind one lock."""
+
+    __slots__ = ("executor", "ring", "workers", "retired", "lock")
+
+    def __init__(self, executor, ring, workers: int):
+        self.executor = executor
+        self.ring = ring
+        self.workers = workers
+        # Executors retired by resize(), still draining their in-flight
+        # items: (executor, joiner thread, worker count). shutdown() joins
+        # these BEFORE unlinking shm segments — a retired worker mid-slot-
+        # write racing ring.cleanup() was the shutdown-during-resize bug.
+        self.retired: list = []
+        self.lock = threading.Lock()
+
+
+def _drain_retired(executor) -> None:
+    """Retire-thread body: wait out the retired executor's in-flight items
+    (their results are still owed to an ``imap`` consumer — dropping them
+    would hole the plan), then join its workers."""
+    executor.shutdown(wait=True, cancel_futures=False)
+
+
+def _teardown_pool(state: _PoolState) -> None:
     """Shutdown body shared by :meth:`WorkerPool.shutdown` and the GC-time
-    finalizer. Order matters: poison the slot queue FIRST so a worker
-    blocked waiting for a free slot wakes and finishes (executor shutdown
-    joins workers), then unlink the segments."""
+    finalizer. Order matters: poison the slot queue FIRST (sized for every
+    worker, current AND retired) so any worker blocked waiting for a free
+    slot wakes and finishes, then join the retired executors' drains, then
+    the live executor, and only then unlink the segments — a worker still
+    writing a slot when the segment unlinks degrades that batch to the
+    pickle fallback at best."""
+    with state.lock:
+        executor = state.executor
+        ring = state.ring
+        retired = list(state.retired)
+        total_workers = state.workers + sum(n for _, _, n in retired)
     if ring is not None:
-        ring.poison(num_workers)
+        ring.poison(total_workers)
+    for old, joiner, _ in retired:
+        joiner.join(timeout=30.0)
+        # Idempotent (the joiner already ran shutdown); cancel_futures covers
+        # a joiner that timed out wedged.
+        old.shutdown(wait=True, cancel_futures=True)
     executor.shutdown(wait=True, cancel_futures=True)
     if ring is not None:
         ring.cleanup()
@@ -238,27 +281,105 @@ class WorkerPool:
         # the exact hazard upstream's SafeLanceDataset exists to avoid.
         # (shm_args carries an mp.Queue: initargs travel as spawn-time
         # Process arguments, the one context where that pickle is legal.)
+        # Kept so resize() can build replacement executors with the same
+        # worker environment.
+        self._ctx = ctx
+        self._initargs = (reader_spec, decode_fn,
+                          list(columns) if columns is not None else None,
+                          read_retries, retry_backoff_s, shm_args)
         self._pool = ProcessPoolExecutor(
             max_workers=num_workers,
             mp_context=ctx,
             initializer=_init_worker,
-            initargs=(reader_spec, decode_fn,
-                      list(columns) if columns is not None else None,
-                      read_retries, retry_backoff_s, shm_args),
+            initargs=self._initargs,
         )
         # Leak guard: if the owning trainer crashes (or simply drops the
         # pool without shutdown()), the finalizer still tears the executor
         # down at GC / interpreter exit — spawned decode processes never
         # outlive their parent as orphans and shm slots never outlive the
-        # pool. Registered against the executor/ring objects directly — a
-        # finalizer closing over `self` would keep the pool alive forever.
-        self._finalizer = weakref.finalize(
-            self, _teardown_pool, self._pool, self._ring, num_workers,
-        )
+        # pool. Registered against a shared state holder (not `self`, which
+        # the finalizer would pin alive forever; not the executor, which
+        # resize() swaps out from under a long-lived pool).
+        self._state = _PoolState(self._pool, self._ring, num_workers)
+        self._finalizer = weakref.finalize(self, _teardown_pool, self._state)
 
     @property
     def closed(self) -> bool:
         return not self._finalizer.alive
+
+    def resize(self, num_workers: int) -> int:
+        """Grow or shrink the decode pool to ``num_workers`` WITHOUT
+        dropping in-flight batches — the autotuner's actuator.
+
+        Mechanism: a fresh spawn-context executor replaces the live one, so
+        every subsequent ``imap`` submission lands on the new width, while
+        the old executor *retires*: a daemon joiner thread waits out its
+        in-flight items (their results are still owed, in order, to the
+        consumer's future deque) and joins its workers. The shm ring is
+        shared by session name + token queue, so old and new workers
+        interleave slot writes safely; the consumer acks tokens regardless
+        of which executor produced the descriptor.
+
+        Shutdown ordering (the regression this API shipped with a fix for):
+        ``shutdown()`` joins every retired executor's drain BEFORE
+        unlinking the shm segments, so a retired worker mid-slot-write can
+        never race ``ring.cleanup()``.
+
+        Note the ring's slot count is fixed at construction (default
+        ``2 × initial workers``): growing far beyond the initial width
+        still works, but workers then contend for slots — size
+        ``shm_slots`` generously when a run expects to be autotuned up.
+
+        Returns the applied worker count. No-op (same count) returns
+        immediately.
+        """
+        if num_workers < 1:
+            raise ValueError("WorkerPool needs num_workers >= 1")
+        if self.closed:
+            raise RuntimeError("WorkerPool is shut down")
+        state = self._state
+        with state.lock:
+            if num_workers == state.workers:
+                return num_workers
+            old = state.executor
+            old_workers = state.workers
+            new = ProcessPoolExecutor(
+                max_workers=num_workers,
+                mp_context=self._ctx,
+                initializer=_init_worker,
+                initargs=self._initargs,
+            )
+            state.executor = new
+            state.workers = num_workers
+            # Handle swap is GIL-atomic; imap reads it per submission, so
+            # pending futures from the old executor and new submissions on
+            # the new one interleave in the consumer's deque in plan order.
+            self._pool = new  # ldt: ignore[LDT1002] -- atomic handle swap under state.lock; imap's per-submit read tolerates either executor
+            self.num_workers = num_workers  # ldt: ignore[LDT1002] -- monotonic int swap, advisory reads only
+            joiner = threading.Thread(
+                target=_drain_retired, args=(old,), daemon=True,
+                name="ldt-workerpool-retire",
+            )
+            state.retired.append((old, joiner, old_workers))
+            joiner.start()
+        default_registry().counter("workers_resizes_total").inc()
+        default_registry().gauge("workers_pool_size").set(num_workers)
+        return num_workers
+
+    def tunables(self):
+        """The autotuner's knob: decode worker count, bounded by the host's
+        core count (growing decode processes past the cores that would run
+        them only adds contention)."""
+        from ..tune.tunable import Tunable
+
+        return [Tunable(
+            "workers",
+            lambda: self.num_workers,
+            self.resize,
+            lo=1,
+            hi=max(2, os.cpu_count() or 2, self.num_workers),
+            doc="decode worker processes (WorkerPool.resize)",
+        )]
 
     def imap(self, items: Iterable, window: int = 0) -> Iterator[dict]:
         """Ordered streaming map: results yielded in submission order, at most
@@ -290,7 +411,7 @@ class WorkerPool:
         pending: deque = deque()
         try:
             for item in it:
-                pending.append(self._pool.submit(_run_item, item))
+                pending.append(self._submit(item))
                 if len(pending) >= window:
                     yield _result(pending.popleft())
             while pending:
@@ -304,6 +425,17 @@ class WorkerPool:
                 # generator close behind in-flight decodes).
                 if not fut.cancel() and self._ring is not None:
                     fut.add_done_callback(self._reclaim_slot)
+
+    def _submit(self, item):
+        """Submit under the pool-state lock: ``resize`` swaps the executor
+        and then (from its joiner thread, after releasing the lock) shuts
+        the old one down — an unlocked read-then-submit could land on the
+        retired executor *after* its shutdown and raise. Serialized here, a
+        submit either lands on the old executor before the swap (its work
+        item is already enqueued, so the retire drain completes it) or on
+        the new one after."""
+        with self._state.lock:
+            return self._state.executor.submit(_run_item, item)
 
     def _unwrap(self, out):
         """Tagged worker result → batch dict (shm read + slot ack, or the

@@ -44,6 +44,10 @@ class FleetAgent:
         heartbeat_interval_s: float = 0.0,  # 0 = coordinator-advertised
         dial_timeout_s: float = 5.0,
         backoff_s: float = 0.2,  # doubles per failure, capped at ~5s
+        pressure_fn: Optional[Callable[[], dict]] = None,  # windowed
+        # stall/occupancy this member reports per heartbeat (the
+        # coordinator's scale-recommendation input; None = no pressure
+        # field, pre-r9 heartbeat shape)
     ):
         self.coordinator_host, self.coordinator_port = P.parse_hostport(
             coordinator_addr
@@ -55,6 +59,7 @@ class FleetAgent:
         self.num_fragments = num_fragments
         self.on_lease_change = on_lease_change
         self.counters = counters
+        self.pressure_fn = pressure_fn
         self.heartbeat_interval_s = heartbeat_interval_s
         self.dial_timeout_s = dial_timeout_s
         self.backoff_s = backoff_s
@@ -118,11 +123,17 @@ class FleetAgent:
         return True
 
     def _heartbeat_once(self) -> None:
+        payload = {
+            "server_id": self.server_id,
+            "generation": self.generation,
+        }
+        if self.pressure_fn is not None:
+            try:
+                payload["pressure"] = self.pressure_fn()
+            except Exception:  # noqa: BLE001 — telemetry must never kill
+                pass  # the heartbeat that keeps the lease alive
         try:
-            msg_type, reply = self._call(P.MSG_FLEET_HEARTBEAT, {
-                "server_id": self.server_id,
-                "generation": self.generation,
-            })
+            msg_type, reply = self._call(P.MSG_FLEET_HEARTBEAT, payload)
         except (ConnectionError, OSError, P.ProtocolError):
             self._count("fleet_heartbeat_errors")
             return

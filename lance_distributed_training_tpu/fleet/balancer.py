@@ -44,11 +44,32 @@ from typing import Callable, Iterator, Optional, Sequence
 
 from ..obs.lineage import observe_wire_lineage
 from ..obs.registry import MetricsRegistry, default_registry
+from ..tune.tunable import AdjustableQueue, Tunable, _LiveQueues
 from ..utils.metrics import ServiceCounters
 from ..utils.retry import RetryPolicy, retrying
 from ..service import protocol as P
 
-__all__ = ["FleetLoader", "members_for_process"]
+__all__ = ["FleetLoader", "members_for_process", "resolve_fleet"]
+
+
+def resolve_fleet(coordinator_addr: str, timeout_s: float = 10.0) -> dict:
+    """One RESOLVE round-trip: the coordinator's membership payload —
+    generation, stripe table, per-member heartbeat-reported pressure, and
+    the scale recommendation. Shared by :class:`FleetLoader` and
+    ``ldt fleet recommend`` (the operator's view of the same answer)."""
+    host, port = P.parse_hostport(coordinator_addr)
+    timeout_s = min(float(timeout_s), 10.0)
+    with socket.create_connection((host, port), timeout=timeout_s) as sock:
+        P.send_msg(sock, P.MSG_FLEET_RESOLVE, {})
+        msg_type, reply = P.recv_msg(
+            sock, deadline=time.monotonic() + timeout_s
+        )
+    if msg_type != P.MSG_FLEET_RESOLVE_OK:
+        raise P.ProtocolError(
+            f"coordinator answered message type {msg_type}: "
+            f"{reply.get('message', '')}"
+        )
+    return reply
 
 _SENTINEL = object()
 _STRIPE_END = object()
@@ -359,6 +380,66 @@ class FleetLoader:
         # uses, so a checkpoint resume IS a restripe from the saved step.
         self._start_step = 0
         self._yielded = 0
+        # Autotune surface (tune/): live merge-queue bound + stripe width.
+        self._live = _LiveQueues()
+        # 0 = stripe over every assigned member (the fixed-knob default,
+        # unchanged behavior); >0 caps the round at the first N of THIS
+        # process's member slice. Width changes apply at the next round
+        # boundary — _restripe asks the orchestrator to end the current
+        # round at the cursor, the exact move failover already makes, so
+        # the stream stays bit-identical through a re-stripe.
+        self.stripe_width = 0
+        self._last_round_width = 1
+        # This process's assigned membership size at the last round open
+        # (pre-cap; 0 = no round yet): the effective-width ceiling a width
+        # change is judged against, so growing past live membership never
+        # churns a round it cannot change.
+        self._last_assigned = 0
+        self._restripe = threading.Event()
+
+    def set_prefetch(self, depth: int) -> int:
+        """Autotune actuator: the merged-stream prefetch bound, live."""
+        depth = max(1, int(depth))
+        self.prefetch = depth  # ldt: ignore[LDT1002] -- atomic int swap; readers take any recent value
+        self._live.resize_total(depth)
+        return depth
+
+    def set_stripe_width(self, width: int) -> int:
+        """Autotune actuator: re-stripe the plan over ``width`` members.
+        Signals the orchestrator to end the current round at its cursor and
+        open a fresh striping — the same cursor-preserving move failover
+        makes, so no step is lost, duplicated, or reordered. The effective
+        width is capped by live membership at round-open time, and a change
+        that cannot alter the effective count (growing past the members
+        this process has) records the request WITHOUT churning the round —
+        ending a healthy merge early buys nothing."""
+        width = max(1, int(width))
+        assigned = self._last_assigned
+        old = self.stripe_width or assigned or self._last_round_width
+        self.stripe_width = width  # ldt: ignore[LDT1002] -- atomic int swap read at round-open
+        if assigned:
+            old = min(old, assigned)
+            width = min(width, assigned)
+        if width != old:
+            self._restripe.set()
+        return self.stripe_width
+
+    def tunables(self):
+        """Autotune registration surface (tune/)."""
+        return [
+            Tunable(
+                "prefetch", lambda: self.prefetch, self.set_prefetch,
+                lo=1, hi=16,
+                doc="merged host batches buffered ahead of the consumer",
+            ),
+            Tunable(
+                "stripe_width",
+                lambda: self.stripe_width or self._last_round_width,
+                self.set_stripe_width,
+                lo=1, hi=32,
+                doc="fleet members this shard's plan stripes across",
+            ),
+        ]
 
     def state_dict(self) -> dict:
         return {"epoch": int(self.epoch), "step": int(self._yielded)}
@@ -378,20 +459,14 @@ class FleetLoader:
     # -- coordinator --------------------------------------------------------
 
     def _resolve_once(self) -> dict:
-        with socket.create_connection(
-            (self.coordinator_host, self.coordinator_port),
-            timeout=min(self.timeout_s, 10.0),
-        ) as sock:
-            P.send_msg(sock, P.MSG_FLEET_RESOLVE, {})
-            msg_type, reply = P.recv_msg(
-                sock, deadline=time.monotonic() + min(self.timeout_s, 10.0)
-            )
-        if msg_type != P.MSG_FLEET_RESOLVE_OK:
-            raise P.ProtocolError(
-                f"coordinator answered message type {msg_type}: "
-                f"{reply.get('message', '')}"
-            )
-        return reply
+        # Re-bracket IPv6 for the shared parser (parse_hostport rejects a
+        # bare "::1:port" as ambiguous, by design).
+        host = self.coordinator_host
+        if ":" in host:
+            host = f"[{host}]"
+        return resolve_fleet(
+            f"{host}:{self.coordinator_port}", timeout_s=self.timeout_s
+        )
 
     def _resolve_members(
         self, stop: Optional[threading.Event] = None,
@@ -591,6 +666,17 @@ class FleetLoader:
             num_steps = int(self._num_steps)
             while cursor < num_steps and not stop.is_set():
                 members = self._resolve_members(stop)
+                # Autotune stripe width: cap the round at the first N of
+                # this process's slice (0 = all, the fixed-knob default).
+                # Clearing the restripe flag here (not when it is noticed)
+                # makes a width change that lands mid-round-open coalesce
+                # into the round it is about to shape.
+                self._restripe.clear()
+                self._last_assigned = len(members)  # ldt: ignore[LDT1002] -- advisory ceiling for set_stripe_width; torn reads impossible for an int
+                width = self.stripe_width
+                if width and width < len(members):
+                    members = members[:width]
+                self._last_round_width = len(members)  # ldt: ignore[LDT1002] -- advisory gauge for the tunable getter; torn reads impossible for an int
                 t0 = time.perf_counter()
                 rnd = _StripeRound(self, members, cursor, stop)
                 try:
@@ -607,6 +693,13 @@ class FleetLoader:
                     )
                 try:
                     while cursor < num_steps and not stop.is_set():
+                        if self._restripe.is_set():
+                            # Width change: end this round at the cursor —
+                            # the outer loop re-resolves and re-stripes from
+                            # exactly here (failover's move, minus the
+                            # exclusion), so the merged stream is unbroken.
+                            self.counters.add("restripes")
+                            break
                         batch = rnd.next_batch(cursor)
                         if batch is None:  # loader closed
                             return
@@ -632,7 +725,8 @@ class FleetLoader:
         self.counters.gauge("resume_cursor", cursor)
 
     def __iter__(self) -> Iterator[dict]:
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        q: "queue.Queue" = AdjustableQueue(self.prefetch)
+        self._live.install([q])
         stop = threading.Event()
         receiver = threading.Thread(
             target=self._receive, args=(q, stop), daemon=True,
@@ -663,6 +757,7 @@ class FleetLoader:
                     self._release(host)
         finally:
             stop.set()
+            self._live.clear()
             while receiver.is_alive():
                 try:
                     # Drained items are undelivered host batches — return

@@ -83,13 +83,19 @@ class CoordinatorConfig:
     # as ServeConfig.metrics_port: None = off, 0 = ephemeral)
     metrics_host: str = "127.0.0.1"  # loopback default; /healthz lists
     # member addresses unauthenticated, so non-loopback is an opt-in
+    scale_up_stall_pct: float = 50.0  # a member heartbeat reporting a
+    # windowed stall above this flips the fleet recommendation to
+    # "scale_up" (decode-starved clients — add a member)
+    scale_down_stall_pct: float = 5.0  # every member below this (with >1
+    # members and clients attached) makes the fleet a "drain_candidate"
+    # (capacity to spare — an operator may drain one member)
 
 
 class _Member:
     """One registered data server and its current lease."""
 
     __slots__ = ("server_id", "addr", "num_fragments", "last_heartbeat",
-                 "stripe_index", "fragment_lo", "fragment_hi")
+                 "stripe_index", "fragment_lo", "fragment_hi", "pressure")
 
     def __init__(self, server_id: str, addr: str, num_fragments: int):
         self.server_id = server_id
@@ -99,6 +105,10 @@ class _Member:
         self.stripe_index = 0
         self.fragment_lo = 0
         self.fragment_hi = 0
+        # Latest heartbeat-reported windowed pressure ({"stall_pct": …,
+        # "active_clients": …}; None until a pressure-carrying heartbeat —
+        # pre-r9 members never send one and simply stay None).
+        self.pressure: Optional[dict] = None
 
     def lease(self, generation: int, stripe_count: int) -> dict:
         return {
@@ -170,10 +180,67 @@ class Coordinator:
                     "fragment_lo": m.fragment_lo,
                     "fragment_hi": m.fragment_hi,
                     "heartbeat_age_s": round(now - m.last_heartbeat, 3),
+                    "pressure": m.pressure,
                 }
                 for m in members
             ],
+            "recommendation": self._recommend_locked(),
         }
+
+    def _recommend_locked(self) -> dict:
+        """Aggregate the members' heartbeat-reported pressure into one
+        scale recommendation (caller holds ``_lock``). Advisory by design —
+        the coordinator never spawns or kills members; an operator (or a
+        later PR's autoscaler) acts on ``ldt fleet recommend`` /
+        ``/healthz`` / the ``fleet_scale_recommendation`` gauge.
+
+        * any member's windowed stall >= ``scale_up_stall_pct`` →
+          ``scale_up`` (its clients are decode-starved; add a member),
+        * every reporting member <= ``scale_down_stall_pct`` with clients
+          attached and >1 members → ``drain_candidate`` (capacity to
+          spare),
+        * otherwise (or before any pressure report) → ``ok``.
+        """
+        reported = [
+            m for m in self._members.values()
+            if isinstance(m.pressure, dict)
+        ]
+        if not reported:
+            return {"action": "ok", "code": 0,
+                    "reason": "no pressure reports yet"}
+        worst = max(reported,
+                    key=lambda m: m.pressure.get("stall_pct", 0.0))
+        worst_stall = float(worst.pressure.get("stall_pct", 0.0))
+        cfg = self.config
+        if worst_stall >= cfg.scale_up_stall_pct:
+            return {
+                "action": "scale_up", "code": 1,
+                "member": worst.server_id,
+                "stall_pct": worst_stall,
+                "reason": (
+                    f"member {worst.server_id} stall "
+                    f"{worst_stall:.1f}% >= {cfg.scale_up_stall_pct:.1f}%"
+                ),
+            }
+        serving = [
+            m for m in reported
+            if m.pressure.get("active_clients", 0)
+        ]
+        if (
+            len(self._members) > 1
+            and serving
+            and worst_stall <= cfg.scale_down_stall_pct
+        ):
+            return {
+                "action": "drain_candidate", "code": -1,
+                "stall_pct": worst_stall,
+                "reason": (
+                    f"all members <= {cfg.scale_down_stall_pct:.1f}% "
+                    "stall with clients attached — capacity to spare"
+                ),
+            }
+        return {"action": "ok", "code": 0, "stall_pct": worst_stall,
+                "reason": "pressure within band"}
 
     # -- request handlers ---------------------------------------------------
 
@@ -221,11 +288,33 @@ class Coordinator:
                                "re-register"
                 }
             member.last_heartbeat = time.monotonic()
+            pressure = req.get("pressure")
+            if isinstance(pressure, dict):
+                member.pressure = dict(pressure)
+            recommendation = self._recommend_locked()
+            stalls = [
+                float(m.pressure.get("stall_pct", 0.0))
+                for m in self._members.values()
+                if isinstance(m.pressure, dict)
+            ]
             reply = {
                 "generation": self.generation,
                 "lease": member.lease(self.generation, len(self._members)),
             }
         self.registry.counter("fleet_heartbeats_total").inc()
+        # Pressure surface (autotune fleet half): scraped series an
+        # operator's alerting keys on, refreshed per heartbeat. Set outside
+        # the lock — the registry has its own.
+        if stalls:
+            self.registry.gauge("fleet_pressure_stall_pct_max").set(
+                max(stalls)
+            )
+            self.registry.gauge("fleet_pressure_stall_pct_mean").set(
+                sum(stalls) / len(stalls)
+            )
+        self.registry.gauge("fleet_scale_recommendation").set(
+            recommendation.get("code", 0)
+        )
         return P.MSG_FLEET_HEARTBEAT_OK, reply
 
     def _handle_deregister(self, req: dict) -> tuple:

@@ -32,6 +32,16 @@ Robustness series (r8, recorded by ``utils/checkpoint.py`` /
 * ``retry_attempts_total`` — counter: reconnect retries across ALL
   subsystems (client connects, fleet resolves/dials) after unification in
   ``utils/retry.py``.
+
+Autotune series (r9, recorded by ``tune/`` into the default registry):
+``autotune_ticks_total`` / ``autotune_decisions_total`` /
+``autotune_reverts_total`` counters, ``autotune_knob_<name>`` gauges, and
+``autotune_bottleneck`` (coded attribution — README "Autotune"); the fleet
+half adds ``fleet_pressure_stall_pct_max``/``_mean`` and
+``fleet_scale_recommendation`` on the coordinator. :class:`RegistryDelta`
+is the windowed view the controller (and bench scripts) read — deltas
+since the previous call, histogram percentiles over the window's own
+bucket increments.
 """
 
 from .http import MetricsHTTPServer  # noqa: F401
@@ -46,7 +56,9 @@ from .registry import (  # noqa: F401
     Gauge,
     Histogram,
     MetricsRegistry,
+    RegistryDelta,
     default_registry,
+    percentile_from_counts,
     render_prometheus,
 )
 from .spans import (  # noqa: F401
@@ -63,8 +75,10 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "MetricsHTTPServer",
+    "RegistryDelta",
     "DEFAULT_MS_BUCKETS",
     "default_registry",
+    "percentile_from_counts",
     "render_prometheus",
     "Span",
     "SpanTracer",

@@ -40,7 +40,9 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "RegistryDelta",
     "default_registry",
+    "percentile_from_counts",
     "render_prometheus",
 ]
 
@@ -61,6 +63,43 @@ def _check_name(name: str) -> str:
             "(a Prometheus-safe lower_snake_case name)"
         )
     return name
+
+
+def percentile_from_counts(
+    bounds: Sequence[float],
+    counts: Sequence[int],
+    total: int,
+    q: float,
+    observed_max: float = math.nan,
+) -> float:
+    """q-th percentile (0 < q <= 100) of a fixed-bucket count vector by
+    linear interpolation — the shared math behind
+    :meth:`Histogram.percentile` and the windowed-delta view
+    (:class:`RegistryDelta`), where ``counts`` is a *difference* of two
+    cumulative snapshots. A rank landing in the +Inf bucket clamps to
+    ``observed_max`` when known (lifetime histograms track it) or the top
+    finite bound (delta windows, which have no per-window max). NaN when
+    ``total`` is 0."""
+    if total <= 0:
+        return math.nan
+    # Fractional rank, no ceil — matches Prometheus histogram_quantile
+    # (one observation in (1, 10] gives p50 = 5.5, not the bucket top).
+    rank = total * min(max(q, 0.0), 100.0) / 100.0
+    cum = 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if cum + c >= rank:
+            if i >= len(bounds):  # overflow bucket
+                if not math.isnan(observed_max):
+                    return max(bounds[-1], observed_max)
+                return bounds[-1]
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            frac = (rank - cum) / c
+            return lo + (hi - lo) * frac
+        cum += c
+    return bounds[-1]
 
 
 def _fmt(v: float) -> str:
@@ -179,24 +218,9 @@ class Histogram:
             counts = list(self._counts)
             total = self._count
             observed_max = self._max
-        if total == 0:
-            return math.nan
-        # Fractional rank, no ceil — matches Prometheus histogram_quantile
-        # (one observation in (1, 10] gives p50 = 5.5, not the bucket top).
-        rank = total * min(max(q, 0.0), 100.0) / 100.0
-        cum = 0
-        for i, c in enumerate(counts):
-            if c == 0:
-                continue
-            if cum + c >= rank:
-                if i >= len(self.bounds):  # overflow bucket
-                    return max(self.bounds[-1], observed_max)
-                lo = self.bounds[i - 1] if i > 0 else 0.0
-                hi = self.bounds[i]
-                frac = (rank - cum) / c
-                return lo + (hi - lo) * frac
-            cum += c
-        return self.bounds[-1]
+        return percentile_from_counts(
+            self.bounds, counts, total, q, observed_max
+        )
 
     def percentiles(self, qs: Iterable[float] = (50, 95, 99)) -> Dict[str, float]:
         """``{"p50": …, "p95": …, "p99": …}`` for the given quantiles."""
@@ -311,6 +335,62 @@ def render_prometheus(registry: MetricsRegistry) -> str:
         else:
             lines.append(f"{name} {_fmt(metric.value)}")  # type: ignore
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+class RegistryDelta:
+    """Windowed view over a registry: each :meth:`delta` returns what
+    happened **since the previous call** — the form a controller (or a bench
+    script that used to scrape ``/metrics`` twice and subtract by hand) can
+    actually act on. Cumulative series answer "how much ever"; a control
+    loop needs "how much in the last window".
+
+    Output is one flat ``{name: float}`` dict per window:
+
+    * counters → the window's increment (``name``),
+    * gauges → the current value verbatim (``name`` — gauges are already
+      instantaneous),
+    * histograms → ``name_count`` / ``name_sum`` window increments plus
+      ``name_p50/p95/p99`` interpolated over the *window's* bucket deltas
+      (only when the window saw observations; a +Inf-bucket rank clamps to
+      the top finite bound — delta windows have no per-window max).
+
+    Metrics created after the first call simply appear with their full value
+    as the first delta (their previous snapshot is implicitly zero). One
+    tracker per consumer: two consumers sharing an instance would steal each
+    other's windows.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None else _DEFAULT
+        # name -> last-seen raw state: float for counters, (counts, sum,
+        # count) for histograms. Single-consumer by contract (no lock).
+        self._prev: Dict[str, object] = {}
+
+    def delta(self, qs: Iterable[float] = (50, 95, 99)) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for name, metric in self.registry.metrics().items():
+            if isinstance(metric, Histogram):
+                counts, total_sum, total = metric.snapshot()
+                prev = self._prev.get(name)
+                if prev is None:
+                    prev = ([0] * len(counts), 0.0, 0)
+                dcounts = [a - b for a, b in zip(counts, prev[0])]
+                dcount = total - prev[2]
+                out[f"{name}_count"] = float(dcount)
+                out[f"{name}_sum"] = total_sum - prev[1]
+                if dcount > 0:
+                    for q in qs:
+                        out[f"{name}_p{int(q)}"] = percentile_from_counts(
+                            metric.bounds, dcounts, dcount, q
+                        )
+                self._prev[name] = (counts, total_sum, total)
+            elif isinstance(metric, Counter):
+                value = metric.value
+                out[name] = value - float(self._prev.get(name, 0.0))
+                self._prev[name] = value
+            else:  # Gauge: instantaneous, passes through
+                out[name] = metric.value  # type: ignore[union-attr]
+        return out
 
 
 _DEFAULT = MetricsRegistry()

@@ -35,6 +35,7 @@ from typing import Callable, Iterator, Optional, Sequence
 from ..obs.lineage import observe_wire_lineage
 from ..obs.registry import MetricsRegistry, default_registry
 from ..obs.spans import span
+from ..tune.tunable import AdjustableQueue, Tunable, _LiveQueues
 from ..utils.metrics import ServiceCounters
 from ..utils.retry import RetryPolicy, retrying
 from . import protocol as P
@@ -131,6 +132,25 @@ class RemoteLoader:
         # mid-epoch reconnects already use.
         self._start_step = 0
         self._yielded = 0
+        # Autotune surface (tune/): the live prefetch queue.
+        self._live = _LiveQueues()
+
+    def set_prefetch(self, depth: int) -> int:
+        """Autotune actuator: move the receive-prefetch bound, live —
+        deeper buffering absorbs wire/decode jitter from the service
+        without touching the stream's content or order."""
+        depth = max(1, int(depth))
+        self.prefetch = depth  # ldt: ignore[LDT1002] -- atomic int swap; readers take any recent value
+        self._live.resize_total(depth)
+        return depth
+
+    def tunables(self):
+        """Autotune registration surface (tune/)."""
+        return [Tunable(
+            "prefetch", lambda: self.prefetch, self.set_prefetch,
+            lo=1, hi=16,
+            doc="received host batches buffered ahead of the consumer",
+        )]
 
     def state_dict(self) -> dict:
         return {"epoch": int(self.epoch), "step": int(self._yielded)}
@@ -411,7 +431,8 @@ class RemoteLoader:
                     pass
 
     def __iter__(self) -> Iterator[dict]:
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        q: "queue.Queue" = AdjustableQueue(self.prefetch)
+        self._live.install([q])
         stop = threading.Event()
         receiver = threading.Thread(
             target=self._receive, args=(q, stop), daemon=True,
@@ -445,6 +466,7 @@ class RemoteLoader:
                     self._release(host)
         finally:
             stop.set()
+            self._live.clear()
             # recv_msg may be blocked on a healthy-but-idle socket;
             # closing it unblocks the receiver thread immediately.
             self._close_conn()

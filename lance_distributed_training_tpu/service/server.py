@@ -495,6 +495,47 @@ class DataService:
         # sender thread as chaos("send", peer, step) before each batch
         # frame. None (the production value) costs one attribute load.
         self.chaos = None
+        # Pressure window anchor (autotune fleet half): previous counter
+        # snapshot + its monotonic stamp. Touched only by pressure(), whose
+        # single caller is the fleet agent's heartbeat thread.
+        self._pressure_prev: tuple = ({}, time.monotonic())
+
+    def pressure(self) -> dict:
+        """Windowed pressure since the previous call — what this member
+        reports in fleet heartbeats so the Coordinator can aggregate a
+        scale-up/drain recommendation (tune/, the fleet half).
+
+        ``stall_pct`` is the decode-starvation share: the fraction of the
+        window's (wall × active sessions) that sender threads spent waiting
+        on empty batch queues. High = this member's decode plane cannot
+        keep its clients fed (the scale-UP signal); near zero with clients
+        attached = capacity to spare (a drain candidate). Single-caller
+        contract: the heartbeat thread owns the window anchor."""
+        now = time.monotonic()
+        snap = self.counters.snapshot()
+        prev, prev_t = self._pressure_prev
+        self._pressure_prev = (snap, now)
+        window_s = max(now - prev_t, 1e-6)
+
+        def d(key: str) -> float:
+            key = f"svc_{key}"
+            return snap.get(key, 0.0) - prev.get(key, 0.0)
+
+        with self._sessions_lock:
+            active = len(self._sessions)
+        stall_pct = 0.0
+        if active:
+            stall_pct = min(
+                100.0,
+                100.0 * d("queue_empty_s") / (window_s * active),
+            )
+        return {
+            "stall_pct": round(stall_pct, 2),
+            "active_clients": active,
+            "queue_depth": snap.get("svc_queue_depth", 0.0),
+            "batches_sent": d("batches_sent"),
+            "window_s": round(window_s, 3),
+        }
 
     # -- data plane --------------------------------------------------------
 
@@ -643,6 +684,10 @@ class DataService:
                 on_lease_change=self._on_lease_change,
                 counters=self.counters,
                 heartbeat_interval_s=self.config.heartbeat_interval_s,
+                # Autotune fleet half: every heartbeat carries this
+                # member's windowed stall/occupancy so the coordinator can
+                # recommend scale-up/drain (README "Autotune").
+                pressure_fn=self.pressure,
             ).start()
             self._log(
                 f"fleet member {self.fleet_agent.server_id} -> "

@@ -157,6 +157,16 @@ class TrainConfig:
     placement_depth: int = 2  # device-resident batches the placement ring
     # keeps ahead of the step; 2 double-buffers (one consumed, one in
     # flight), more pins extra HBM for little added overlap
+    autotune: bool = True  # closed-loop pipeline autotuning (tune/): a
+    # background controller snapshots windowed obs/ deltas each interval,
+    # attributes the bottleneck, and actuates live knobs — decode worker
+    # count, prefetch depth, buffer-pool budget, placement ring depth,
+    # fleet stripe width — within their declared bounds. Capacity only:
+    # the batch stream stays bit-identical in value and order through any
+    # decision. False (--no_autotune) = the exact fixed-knob pipeline of
+    # r8 and earlier (no controller thread, no Tunable ever constructed).
+    autotune_interval_s: float = 1.0  # controller tick period; decisions
+    # additionally sit out a policy cooldown between actuations
     data_echo: int = 1  # >1: run N train steps per host batch ("data
     # echoing", Choi et al. 2019) — each echo re-draws the on-device
     # augmentation / MLM masking rng, so echoes are not exact repeats. When
@@ -1240,6 +1250,7 @@ def train(config: TrainConfig) -> dict:
     # /healthz liveness body, for the lifetime of the run.
     exporter = None
     worker_pool = None
+    tuner = None
     run_exc: Optional[BaseException] = None
     try:
         # Everything that can fail lives inside the try — a bind failure on
@@ -1261,6 +1272,16 @@ def train(config: TrainConfig) -> dict:
             logger.log({"metrics_port": exporter.port}, to_wandb=False)
         if not (config.data_service_addr or config.coordinator_addr):
             worker_pool = _make_worker_pool(config, dataset)
+        if config.autotune:
+            # Closed-loop pipeline autotuning (tune/): one controller for
+            # the whole run; the epoch loop re-registers each rebuilt
+            # loader's knobs. Reads the process registry the exporter
+            # already serves, so autotune_* series ride /metrics for free.
+            from .tune import AutoTuner
+
+            tuner = AutoTuner(
+                interval_s=config.autotune_interval_s,
+            ).start()
         return _train_loop(
             config, dataset, val_dataset, mesh, state, rng, train_step,
             eval_step, logger, timer, worker_pool, ckpt, start_epoch,
@@ -1269,6 +1290,7 @@ def train(config: TrainConfig) -> dict:
             resume_epoch_step=resume_epoch_step,
             resume_global_step=resume_global_step,
             preempt=preempt, chaos=chaos, trace=trace, journal=journal,
+            tuner=tuner,
         )
     except BaseException as exc:
         run_exc = exc
@@ -1279,6 +1301,10 @@ def train(config: TrainConfig) -> dict:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
+        if tuner is not None:
+            # Before the worker pool: a controller mid-tick must not
+            # actuate a resize against a pool that is shutting down.
+            tuner.stop()
         if exporter is not None:
             exporter.stop()
         if worker_pool is not None:
@@ -1318,7 +1344,7 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 total_start, n_devices, results, global_step, profiling,
                 index_pool=None, lr_fn=None, val_pool=None, *,
                 resume_epoch_step=0, resume_global_step=0, preempt=None,
-                chaos=None, trace=None, journal=None):
+                chaos=None, trace=None, journal=None, tuner=None):
     if journal is None:
         journal = _CkptJournal(resume_global_step)
     # HBM-resident dataset cache (--device_cache): filled on the first
@@ -1375,6 +1401,16 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             getattr(loader, "placement_counters", None)
             if loader is not None else None,
         )
+        if tuner is not None:
+            # Register this epoch's live knobs (the loader is rebuilt per
+            # epoch; the controller outlives it). Replay epochs
+            # (device_cache) have no pipeline to tune — empty the set so a
+            # stale epoch's knobs are never actuated.
+            from .tune import collect_tunables
+
+            tuner.set_tunables(collect_tunables(
+                loader, worker_pool, _loader_buffer_pool(config),
+            ) if loader is not None else [])
         # A partially-resumed epoch must not seed the replay cache: it
         # would capture only the post-resume tail and later epochs would
         # silently train on a subset.

@@ -29,11 +29,17 @@
 # losses equal to an uninterrupted control arm; a second trainer SIGTERMed
 # mid-epoch drains with an awaited emergency checkpoint and exit 0 while
 # its /metrics serves the ckpt_* series.
-# Stage 7 — the tier-1 verify command from ROADMAP.md, verbatim — run
+# Stage 7 — autotune smoke (scripts/autotune_smoke.py): a deliberately
+# under-provisioned pipeline (1 decode worker, prefetch 1) driven by a
+# live AutoTuner — the controller must raise the worker count and
+# autotune_decisions_total must be > 0 on a live /metrics scrape, the
+# consumed stream must stay bit-identical to a fixed-knob control pass,
+# and the LDT_AUTOTUNE_TRACE decision trace must replay deterministically.
+# Stage 8 — the tier-1 verify command from ROADMAP.md, verbatim — run
 # under LDT_LOCK_SANITIZER=1: every threading.Lock/RLock the package
 # creates is wrapped to record actual acquisition orderings, and conftest
 # dumps the witness JSON on exit.
-# Stage 8 — `ldt check --lock-witness` against that witness: the runtime
+# Stage 9 — `ldt check --lock-witness` against that witness: the runtime
 # evidence corroborates (or prunes) the static LDT1001 lock-order cycles,
 # and any NEW LDT10xx finding fails the build exactly like stage 1.
 set -e
@@ -123,6 +129,13 @@ echo "== preemption smoke (SIGKILL resume fidelity + SIGTERM drain) =="
 # (no handler runs — the crash-consistency manifest must carry recovery),
 # and the SIGTERM is the real k8s-eviction path asserted to exit 0.
 timeout -k 10 540 env JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/preempt_smoke.py
+
+echo "== autotune smoke (closed-loop controller on live /metrics) =="
+# Real script file (spawn workers re-import __main__): start starved — 1
+# worker, prefetch 1 — and require the controller to grow the pool, count
+# decisions on a live scrape, keep the stream bit-identical, and leave a
+# deterministically-replayable decision trace.
+timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/autotune_smoke.py
 
 echo "== tier-1 tests (lock sanitizer on) =="
 WITNESS=/tmp/_ldt_lock_witness.json

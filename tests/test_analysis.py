@@ -1447,6 +1447,72 @@ def test_ldt1003_inert_without_scanned_dispatchers(tmp_path):
     assert [f for f in findings if f.rule == "LDT1003"] == []
 
 
+# -- LDT1101 tunable bounds ---------------------------------------------------
+
+
+def test_ldt1101_flags_missing_bounds(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        from lance_distributed_training_tpu.tune.tunable import Tunable
+
+        def register(obj):
+            return Tunable("workers", obj.get, obj.set)
+    """})
+    hits = [f for f in findings if f.rule == "LDT1101"]
+    assert len(hits) == 1
+    assert "hi/lo" in hits[0].message
+
+
+def test_ldt1101_flags_one_missing_bound(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        from lance_distributed_training_tpu.tune import Tunable
+
+        def register(obj):
+            return Tunable("workers", obj.get, obj.set, lo=1)
+    """})
+    hits = [f for f in findings if f.rule == "LDT1101"]
+    assert len(hits) == 1 and "hi=" in hits[0].message
+
+
+def test_ldt1101_flags_degenerate_literal_range(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        from lance_distributed_training_tpu.tune.tunable import Tunable
+
+        def register(obj):
+            return Tunable("workers", obj.get, obj.set, lo=8, hi=8)
+    """})
+    hits = [f for f in findings if f.rule == "LDT1101"]
+    assert len(hits) == 1 and "degenerate" in hits[0].message
+
+
+def test_ldt1101_accepts_bounded_and_splat(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        from lance_distributed_training_tpu.tune.tunable import Tunable
+
+        def good(obj):
+            return Tunable("workers", obj.get, obj.set, lo=1, hi=8)
+
+        def computed(obj, n):
+            return Tunable("workers", obj.get, obj.set, lo=1, hi=max(2, n))
+
+        def splat(obj, kw):
+            # **kwargs may carry the bounds: benefit of the doubt (the
+            # runtime keyword-only signature still backstops it).
+            return Tunable("workers", obj.get, obj.set, **kw)
+    """})
+    assert [f for f in findings if f.rule == "LDT1101"] == []
+
+
+def test_ldt1101_ignores_unrelated_tunable_names(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        class Other:
+            pass
+
+        def make():
+            return Other()
+    """})
+    assert [f for f in findings if f.rule == "LDT1101"] == []
+
+
 # -- the seeded fixture package ----------------------------------------------
 
 

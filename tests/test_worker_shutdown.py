@@ -55,6 +55,59 @@ def test_abandoned_pool_finalizer_reaps_workers(tiny_dataset):
         assert not p.is_alive()
 
 
+def test_resize_grow_mid_imap_keeps_order(tiny_dataset):
+    """Autotune actuator: growing the pool mid-stream must complete the
+    plan in order with nothing dropped (in-flight items finish on the
+    retired executor, new submissions land on the new one)."""
+    with WorkerPool(columnar_spec(tiny_dataset.uri), _label_decode, 1) as pool:
+        items = [np.array([i]) for i in range(12)]
+        it = pool.imap(items, window=3)
+        got = [next(it)["label"].tolist() for _ in range(3)]
+        assert pool.resize(2) == 2
+        assert pool.num_workers == 2
+        got += [b["label"].tolist() for b in it]
+        assert got == [[i] for i in range(12)]
+        # And the pool stays usable at the new width.
+        again = list(pool.imap([np.array([5])]))
+        assert again[0]["label"].tolist() == [5]
+
+
+def test_shutdown_during_resize_joins_retired_workers(tiny_dataset):
+    """The shutdown-during-resize regression: shrinking retires an
+    executor whose workers may still hold shm ring slots; shutdown() must
+    join the retired drain BEFORE unlinking the segments — no hang, no
+    leaked /dev/shm segment, no stray processes."""
+    import glob
+
+    pool = WorkerPool(columnar_spec(tiny_dataset.uri), _label_decode, 2)
+    it = pool.imap([np.array([i]) for i in range(8)], window=4)
+    next(it)
+    old_procs = list(pool._pool._processes.values())
+    pool.resize(1)  # shrink: the 2-worker executor retires mid-flight
+    it.close()
+    pool.shutdown()  # must not race the retired workers' slot writes
+    assert pool.closed
+    for p in old_procs:
+        p.join(timeout=10)
+        assert not p.is_alive()
+    session = pool._ring.session if pool._ring is not None else None
+    if session is not None:
+        assert not glob.glob(f"/dev/shm/ldtshm_{session}_*")
+
+
+def test_resize_validates_and_noops(tiny_dataset):
+    pool = WorkerPool(columnar_spec(tiny_dataset.uri), _label_decode, 1)
+    try:
+        with pytest.raises(ValueError, match="num_workers >= 1"):
+            pool.resize(0)
+        assert pool.resize(1) == 1  # same width: no respawn
+        assert pool._state.retired == []
+    finally:
+        pool.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        pool.resize(2)
+
+
 def test_imap_abandonment_cancels_pending(tiny_dataset):
     with WorkerPool(columnar_spec(tiny_dataset.uri), _label_decode, 1) as pool:
         it = pool.imap([np.array([i]) for i in range(16)], window=4)
