@@ -7,11 +7,18 @@ findings so the gate can be adopted incrementally and ratcheted down.
 ``--lock-witness`` feeds a runtime lock-order witness (emitted by the test
 suite under ``LDT_LOCK_SANITIZER=1``) into the LDT1001 cross-check:
 observed orderings corroborate static cycles, contradicted ones prune.
+``--leak-witness`` is the same loop for the LDT1201 ownership family: a
+runtime lease witness (``LDT_LEAK_SANITIZER=1``, ``utils/leaktrack.py``)
+corroborates leaks that reproduced and prunes exercised-and-balanced
+sites, and the report carries the match summary so CI can assert the
+static and runtime halves still overlap.
 
 ``graph``: render the cross-module concurrency model (spawned-thread
 roots, the locks each thread path acquires, the lock-order edges) as
 Graphviz DOT (``--dot``) or a text summary — the machine-checked topology
-the README renders.
+the README renders. ``--ownership`` adds the resource-ownership model:
+resource kinds as diamond nodes, acquire→release edges, red edges for
+leak-on-path findings.
 """
 
 from __future__ import annotations
@@ -40,7 +47,7 @@ def build_check_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ldt check",
         description="AST-based distributed-training lint "
-                    "(rules LDT001-LDT1003; config in [tool.ldt-check])",
+                    "(rules LDT001-LDT1301; config in [tool.ldt-check])",
     )
     p.add_argument("paths", nargs="*", default=None,
                    help="files/dirs to check (default: configured paths)")
@@ -60,9 +67,27 @@ def build_check_parser() -> argparse.ArgumentParser:
                         "orderings corroborate LDT1001 cycles, "
                         "contradicted ones are marked witness_pruned and "
                         "do not fail the gate")
+    p.add_argument("--leak-witness", default=None, metavar="PATH",
+                   help="runtime resource-lease witness JSON (emitted by "
+                        "a test run under LDT_LEAK_SANITIZER=1, "
+                        "utils/leaktrack.py): sites that demonstrably "
+                        "leaked corroborate LDT1201 findings, exercised-"
+                        "and-balanced sites mark them witness_pruned")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule registry and exit")
     return p
+
+
+def _rel_site(site: str, root: str) -> str:
+    """Relativize a witness ``abspath:line`` site to ``root`` — the one
+    join-key discipline BOTH witness families share (the static models
+    report root-relative posix ``path:line`` sites)."""
+    file_part, _, line = site.rpartition(":")
+    try:
+        rel = os.path.relpath(file_part, root)
+    except ValueError:  # different drive (windows): keep absolute
+        rel = file_part
+    return f"{rel.replace(os.sep, '/')}:{line}"
 
 
 def load_lock_witness(path: str, root: str) -> dict:
@@ -71,24 +96,29 @@ def load_lock_witness(path: str, root: str) -> dict:
     {site: count}}`` with sites relativized to ``root`` (``path:line``)."""
     with open(path, encoding="utf-8") as f:
         data = json.load(f)
-
-    def rel_site(site: str) -> str:
-        file_part, _, line = site.rpartition(":")
-        try:
-            rel = os.path.relpath(file_part, root)
-        except ValueError:  # different drive (windows): keep absolute
-            rel = file_part
-        return f"{rel.replace(os.sep, '/')}:{line}"
-
     edges = {
-        (rel_site(e["src"]), rel_site(e["dst"]))
+        (_rel_site(e["src"], root), _rel_site(e["dst"], root))
         for e in data.get("edges", [])
     }
     acquired = {
-        rel_site(site): count
+        _rel_site(site, root): count
         for site, count in data.get("acquired", {}).items()
     }
     return {"edges": edges, "acquired": acquired}
+
+
+def load_leak_witness(path: str, root: str) -> dict:
+    """Parse a ``utils/leaktrack.py`` witness file into the structure the
+    LDT1201 rule consumes: ``{"sites": {"path:line": {"acquired": n,
+    "released": n, "leaked": n}}}`` with sites relativized to ``root`` —
+    the same join-key discipline as the lock witness."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    sites = {
+        _rel_site(site, root): dict(entry)
+        for site, entry in data.get("sites", {}).items()
+    }
+    return {"sites": sites}
 
 
 def check_main(argv: Optional[Sequence[str]] = None,
@@ -120,10 +150,21 @@ def check_main(argv: Optional[Sequence[str]] = None,
     if args.lock_witness:
         try:
             config.lock_witness = load_lock_witness(args.lock_witness, root)
-        except (OSError, ValueError, KeyError, TypeError) as exc:
+        except (OSError, ValueError, KeyError, TypeError,
+                AttributeError) as exc:
             out.write(
                 f"ldt check: unreadable lock witness "
                 f"{args.lock_witness}: {exc}\n"
+            )
+            return 2
+    if args.leak_witness:
+        try:
+            config.leak_witness = load_leak_witness(args.leak_witness, root)
+        except (OSError, ValueError, KeyError, TypeError,
+                AttributeError) as exc:
+            out.write(
+                f"ldt check: unreadable leak witness "
+                f"{args.leak_witness}: {exc}\n"
             )
             return 2
 
@@ -180,6 +221,16 @@ def check_main(argv: Optional[Sequence[str]] = None,
         render_text(
             new, out, grandfathered=len(old), files_checked=files_checked
         )
+        summary = timing.get("leak_witness")
+        if summary is not None:
+            # The corroboration receipt the CI stage greps: runtime lease
+            # evidence mapped onto the static ownership model's acquire
+            # sites.
+            out.write(
+                f"ldt check: leak witness: {summary['matched_sites']}/"
+                f"{summary['runtime_sites']} runtime sites match static "
+                f"acquire sites, {summary['leaked_sites']} leaked\n"
+            )
     return 1 if any(not f.witness_pruned for f in new) else 0
 
 
@@ -199,6 +250,12 @@ def build_graph_parser() -> argparse.ArgumentParser:
     p.add_argument("--dot", action="store_true",
                    help="Graphviz DOT on stdout (pipe through `dot -Tsvg`)"
                         " instead of the text summary")
+    p.add_argument("--ownership", action="store_true",
+                   help="also render the resource-ownership model: "
+                        "resource kinds as diamond nodes beside the "
+                        "thread boxes and lock ellipses, acquire->release "
+                        "edges, RED acquire edges for leak-on-path "
+                        "findings")
     return p
 
 
@@ -228,6 +285,11 @@ def graph_main(argv: Optional[Sequence[str]] = None, out=None) -> int:
     from .concmodel import build_program
 
     program = build_program(modules, config)
+    owner = None
+    if args.ownership:
+        from .ownermodel import build_owner_model
+
+        owner = build_owner_model(program, config)
 
     # thread root -> set of lock keys any function on that root acquires
     root_locks: dict = {}
@@ -271,6 +333,46 @@ def graph_main(argv: Optional[Sequence[str]] = None, out=None) -> int:
                 f'[color="#dc2626", penwidth=2, '
                 f'label="{e.module}:{e.line}"];\n'
             )
+        if owner is not None:
+            # Resource diamonds beside the thread boxes and lock ellipses:
+            # function --acquire--> resource (RED when that acquire site
+            # has a leak-on-path finding), resource --release--> kind's
+            # release verbs.
+            kinds = sorted({r.kind for r in owner.records})
+            for kind in kinds:
+                spec = owner.spec(kind)
+                out.write(
+                    f'  "res:{kind}" [label="{spec.describe or kind}", '
+                    'shape=diamond, style=filled, fillcolor="#dcfce7"];\n'
+                )
+                out.write(
+                    f'  "rel:{kind}" [label="release: '
+                    f'{", ".join(spec.release)}", shape=plaintext];\n'
+                )
+                out.write(f'  "res:{kind}" -> "rel:{kind}" '
+                          '[style=dashed, color="#16a34a"];\n')
+            seen_acq = set()
+            for rec in owner.records:
+                key = (rec.func, rec.kind, rec.leak is not None)
+                if key in seen_acq:
+                    continue
+                seen_acq.add(key)
+                out.write(
+                    f'  "fn:{rec.func}" [label="{_short(rec.func)}", '
+                    'shape=box];\n'
+                )
+                if rec.leak is not None:
+                    out.write(
+                        f'  "fn:{rec.func}" -> "res:{rec.kind}" '
+                        f'[color="#dc2626", penwidth=2, '
+                        f'label="LEAK {rec.module}:{rec.line}"];\n'
+                    )
+                else:
+                    out.write(
+                        f'  "fn:{rec.func}" -> "res:{rec.kind}" '
+                        f'[color="#16a34a", '
+                        f'label="{rec.module}:{rec.line}"];\n'
+                    )
         out.write("}\n")
     else:
         out.write(f"concurrency model over {files_checked} files: "
@@ -295,6 +397,19 @@ def graph_main(argv: Optional[Sequence[str]] = None, out=None) -> int:
                       f"({e.module}:{e.line}, {e.via})\n")
         cycles = program.lock_cycles()
         out.write(f"  lock-order cycles: {len(cycles)}\n")
+        if owner is not None:
+            leaks = [r for r in owner.records if r.leak is not None]
+            out.write(
+                f"  ownership model: {len(owner.records)} acquire sites "
+                f"across {len({r.kind for r in owner.records})} resource "
+                f"kinds, {len(leaks)} leak-on-path\n"
+            )
+            for rec in owner.records:
+                tag = f"  LEAK({rec.leak})" if rec.leak is not None else ""
+                out.write(
+                    f"  resource {rec.kind} acquired in "
+                    f"{_short(rec.func)} ({rec.module}:{rec.line}){tag}\n"
+                )
     return 0
 
 

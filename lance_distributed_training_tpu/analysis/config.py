@@ -103,6 +103,38 @@ class CheckConfig:
     # CLI, never from TOML — {"edges": {(src, dst), ...},
     # "acquired": {site: count}} with root-relative "path:line" sites.
     lock_witness: Optional[dict] = None
+    # LDT12xx resource vocabulary: kind -> {acquire: [patterns],
+    # release: [method names], describe, idempotent}. Acquire patterns
+    # match the resolved callee's dotted tail (case/underscore-folded, so
+    # ``BufferPool.lease`` also matches ``self.buffer_pool.lease``).
+    # Empty dict = the built-in vocabulary (ownermodel.DEFAULT_RESOURCES:
+    # pool-page, shm-token, socket, thread, autotuner). TOML: a
+    # ``[tool.ldt-check.resources.<kind>]`` table per kind.
+    resources: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    # LDT1301 content paths: the computations whose outputs must be pure
+    # functions of (dataset, plan, seed, epoch, cursor) — plan generation,
+    # batch assembly, cursor arithmetic, lineage digests. Entries are
+    # ``path-glob[::function-glob]`` (function globs match dotted
+    # qualnames). Taint sources found in these functions, or in functions
+    # they reach through resolved calls within content modules, are
+    # findings.
+    content_paths: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "lance_distributed_training_tpu/data/samplers.py",
+            "lance_distributed_training_tpu/data/decode.py",
+            "lance_distributed_training_tpu/utils/chaos.py::*.batch_digest",
+            "lance_distributed_training_tpu/*::*.state_dict",
+            "lance_distributed_training_tpu/*::*.load_state_dict",
+        ]
+    )
+    # Extra LDT1301 taint sources appended to the built-in set
+    # (ownermodel.DEFAULT_TAINT_SOURCES): dotted call qualnames, or bare
+    # names matched against the call's function/attribute name.
+    taint_sources: List[str] = dataclasses.field(default_factory=list)
+    # LDT1201 runtime witness (``ldt check --leak-witness``): set by the
+    # CLI, never from TOML — {"sites": {"path:line": {"acquired": n,
+    # "released": n, "leaked": n}}} with root-relative sites.
+    leak_witness: Optional[dict] = None
     # LDT701: the hot-path modules where materialising copies
     # (.to_pylist(), bytes(view[...])) undo the zero-copy batch plane.
     hot_paths: List[str] = dataclasses.field(
@@ -158,6 +190,9 @@ def load_config(root: str) -> CheckConfig:
         "state-paths": "state_paths",
         "dispatch": "dispatch",
         "threadsafe-types": "threadsafe_types",
+        "resources": "resources",
+        "content-paths": "content_paths",
+        "taint-sources": "taint_sources",
     }
     for key, attr in mapping.items():
         if key in section:

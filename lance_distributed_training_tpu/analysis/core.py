@@ -74,12 +74,14 @@ _SUPPRESS_RE = re.compile(
     r"(?:\s*(?:--|—)\s*(?P<reason>\S.*))?"
 )
 
-# The cross-module concurrency rules: their findings assert whole-program
-# properties (a deadlock cycle, a cross-thread race), so an unexplained
-# per-line ignore is exactly the "trust me" a reviewer cannot review.
-# Suppressions for these require a reason string:
+# The cross-module rules: their findings assert whole-program properties
+# (a deadlock cycle, a cross-thread race, a leak-on-path, taint into a
+# content computation), so an unexplained per-line ignore is exactly the
+# "trust me" a reviewer cannot review. Suppressions for the concurrency
+# (LDT10xx), ownership (LDT12xx), and purity (LDT13xx) families require a
+# reason string:
 #     # ldt: ignore[LDT1002] -- GIL-atomic monotonic cursor, torn reads ok
-_REASON_REQUIRED_RE = re.compile(r"LDT10\d\d$")
+_REASON_REQUIRED_RE = re.compile(r"LDT1[023]\d\d$")
 
 
 def _parse_suppressions(lines: Sequence[str]) -> Dict[int, tuple]:
@@ -405,8 +407,11 @@ def analyze_project(root: str, config, timing: Optional[dict] = None):
         if rid not in config.disable
     }
     by_path = {m.relpath: m for m in modules}
-    # The cross-module concurrency model is built at most ONCE per run and
-    # shared by every program-level rule (LDT1001-1003 all consume it).
+    # The cross-module models are built at most ONCE per run and shared by
+    # every program-level rule: ProgramInfo (LDT1001-1003) and, layered on
+    # top of it without re-walking any AST, the ownership/purity model
+    # (LDT1201-1203, LDT1301). Per-family build time is recorded so the
+    # --json report can prove the single-pass contract holds.
     program = None
     if any(
         type(rule).check_program is not Rule.check_program
@@ -414,7 +419,39 @@ def analyze_project(root: str, config, timing: Optional[dict] = None):
     ):
         from .concmodel import build_program
 
+        t0 = _time.perf_counter()
         program = build_program(modules, config)
+        t1 = _time.perf_counter()
+        model_ms = {"concurrency": round((t1 - t0) * 1e3, 3)}
+        if any(
+            getattr(rule, "uses_owner_model", False)
+            for rule in rules.values()
+        ):
+            from .ownermodel import build_owner_model
+
+            owner = build_owner_model(program, config)
+            model_ms["ownership"] = round(
+                (_time.perf_counter() - t1) * 1e3, 3
+            )
+            witness = getattr(config, "leak_witness", None)
+            if witness is not None and timing is not None:
+                # The corroboration receipt the CI leak-witness stage
+                # asserts on: how much of the runtime evidence maps onto
+                # static acquire sites the model knows.
+                static_sites = owner.acquire_sites()
+                wsites = witness.get("sites", {})
+                timing["leak_witness"] = {
+                    "runtime_sites": len(wsites),
+                    "matched_sites": sum(
+                        1 for s in wsites if s in static_sites
+                    ),
+                    "leaked_sites": sum(
+                        1 for v in wsites.values()
+                        if int(v.get("leaked", 0)) > 0
+                    ),
+                }
+        if timing is not None:
+            timing["model_build_ms"] = model_ms
     for rule in rules.values():
         for mod in modules:
             findings.extend(rule.check_module(mod, config))

@@ -76,6 +76,13 @@ def render_json(
     witness contradicted the static inference — rendered, not failing),
     plus the top-level timing fields. Exit-code and baseline semantics are
     unchanged, so existing gate machinery keeps working unmodified.
+
+    Additive v2 fields (r11): ``model_build_ms`` — per-family build time
+    of the shared cross-module models ({"concurrency": ms, "ownership":
+    ms}), the receipt that one ProgramInfo/parse pass serves every
+    whole-program family — and ``leak_witness`` (only when ``ldt check
+    --leak-witness`` ran): {"runtime_sites", "matched_sites",
+    "leaked_sites"}, the static↔runtime corroboration summary.
     """
     records = []
     for f in findings:
@@ -99,7 +106,10 @@ def render_json(
         "grandfathered": grandfathered,
         "wall_time_ms": (timing or {}).get("wall_ms", 0.0),
         "parse_ms": (timing or {}).get("parse_ms", 0.0),
+        "model_build_ms": (timing or {}).get("model_build_ms", {}),
         "findings": records,
     }
+    if (timing or {}).get("leak_witness") is not None:
+        payload["leak_witness"] = timing["leak_witness"]
     json.dump(payload, out, indent=2)
     out.write("\n")

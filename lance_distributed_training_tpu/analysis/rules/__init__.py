@@ -16,9 +16,11 @@ from . import (  # noqa: F401  (import for registration side effect)
     jit_purity,
     lockorder,
     obs,
+    ownership,
     persistence,
     placement,
     protocol,
+    purity,
     resources,
     sharedstate,
     tunables,

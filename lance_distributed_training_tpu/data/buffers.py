@@ -62,6 +62,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..obs.registry import MetricsRegistry, default_registry
+from ..utils import leaktrack
 
 __all__ = [
     "BufferPool",
@@ -180,10 +181,18 @@ class BufferPool:
             # pool lock — this runs from the GC) so the id can be reused.
             outstanding.pop(_key, None)
             gauge.set(len(outstanding))
+            if leaktrack.enabled():
+                # The leak event itself, caught live: a page dropped
+                # without release (LDT1201's witness corroboration).
+                leaktrack.track_dropped("pool-page", _key)
 
         with self._lock:
             outstanding[id(arr)] = weakref.ref(arr, _dropped)
             gauge.set(len(outstanding))
+        if leaktrack.enabled():
+            # depth 3: past this frame and the hook, to lease()'s caller —
+            # the static ownership model's acquire-site join key.
+            leaktrack.track_acquire("pool-page", id(arr), depth=3)
         return arr
 
     def release(self, arr) -> bool:
@@ -198,6 +207,8 @@ class BufferPool:
             self._in_use.set(len(self._outstanding))
             self._pending.append(arr)
             self._sweep_locked()
+        if leaktrack.enabled():
+            leaktrack.track_release("pool-page", id(arr))
         return True
 
     def release_batch(self, batch) -> int:
@@ -418,8 +429,11 @@ class ShmSlotWriter:
         tok = self._acquire()
         if tok is None:  # timeout or shutdown poison: pickle fallback
             return None
-        wait_ms = (time.monotonic_ns() - t0) / 1e6
+        # Unpack the token FIRST (pure tuple destructuring, cannot raise):
+        # from here down the requeue in the except arm owns the slot, so
+        # no statement between acquire and the try can strand it (LDT1201).
         slot, gen, size = tok
+        wait_ms = (time.monotonic_ns() - t0) / 1e6
         try:
             seg, gen, size = self._ensure(slot, gen, size, total)
             resized = size != tok[2]
@@ -522,24 +536,47 @@ class ShmRing:
             raise RuntimeError("ShmRing is closed")
         slot, gen, size = desc["slot"], desc["gen"], desc["size"]
         out: Dict[str, np.ndarray] = {}
-        # Lock only the attach-cache lookup: the slot's CONTENT is
-        # exclusively ours while we hold its token, and serialising the
-        # multi-MB copies would bottleneck multi-client servers on one
-        # reader thread's memcpy.
-        with self._lock:
-            seg = self._attach(slot, size)
-        for name, dtype_str, shape, offset in desc["tensors"]:
-            shape = tuple(shape)
-            src = np.ndarray(
-                shape, np.dtype(dtype_str), buffer=seg.buf, offset=offset
-            )
+        try:
+            # Lock only the attach-cache lookup: the slot's CONTENT is
+            # exclusively ours while we hold its token, and serialising
+            # the multi-MB copies would bottleneck multi-client servers
+            # on one reader thread's memcpy. The attach lives INSIDE the
+            # requeue-protected try: a vanished segment (worker died
+            # mid-epoch, FileNotFoundError here) must return the token
+            # too, not just copy failures.
+            with self._lock:
+                seg = self._attach(slot, size)
+            for name, dtype_str, shape, offset in desc["tensors"]:
+                shape = tuple(shape)
+                src = np.ndarray(
+                    shape, np.dtype(dtype_str), buffer=seg.buf, offset=offset
+                )
+                if buffer_pool is not None:
+                    dst = buffer_pool.lease(shape, dtype_str)
+                else:
+                    dst = np.empty(shape, np.dtype(dtype_str))
+                # Park ownership in `out` BEFORE the copy: if copyto raises
+                # (a torn/stale descriptor), the except arm below can
+                # release every page it leased so far, dst included.
+                out[name] = dst
+                np.copyto(dst, src)
+        except BaseException:
+            # A failed copy-out must not strand resources: return the
+            # leased pages to the pool and — critically — the slot token
+            # to the ring (a lost token shrinks the ring FOREVER; the
+            # writer side already requeues a reset token on its own
+            # failures, this is the reader-side mirror).
             if buffer_pool is not None:
-                dst = buffer_pool.lease(shape, dtype_str)
-            else:
-                dst = np.empty(shape, np.dtype(dtype_str))
-            np.copyto(dst, src)
-            out[name] = dst
+                for arr in out.values():
+                    buffer_pool.release(arr)
+            self._free_q.put((slot, gen, size))
+            if leaktrack.enabled():
+                leaktrack.track_release("shm-token",
+                                        (self.session, slot, gen))
+            raise
         self._free_q.put((slot, gen, size))
+        if leaktrack.enabled():
+            leaktrack.track_release("shm-token", (self.session, slot, gen))
         self._batches.inc()
         self._bytes.inc(desc["total"])
         if desc.get("resized"):
@@ -553,6 +590,10 @@ class ShmRing:
         if self._closed:
             return
         self._free_q.put((desc["slot"], desc["gen"], desc["size"]))
+        if leaktrack.enabled():
+            leaktrack.track_release(
+                "shm-token", (self.session, desc["slot"], desc["gen"])
+            )
 
     def count_fallback(self) -> None:
         self._fallbacks.inc()

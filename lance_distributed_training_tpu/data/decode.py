@@ -143,10 +143,17 @@ class ImageClassificationDecoder:
         )
 
     def decode_payloads(self, payloads: list[bytes]) -> np.ndarray:
-        """JPEG byte strings → ``[N, S, S, 3] uint8`` (native path if built)."""
-        out = self._lease_out(len(payloads))
+        """JPEG byte strings → ``[N, S, S, 3] uint8`` (native path if built).
+
+        Each path leases its output page immediately before handing it to
+        the call that fills it (the ``out=`` transfer) — leasing up front
+        would strand the page if a PIL decode raised first (LDT1201's
+        exception-edge leak class).
+        """
         if self._native is not None:
-            images, failed = self._native(payloads, self.image_size, out=out)
+            images, failed = self._native(
+                payloads, self.image_size, out=self._lease_out(len(payloads))
+            )
             if failed.any():
                 # Corrupt-for-libjpeg rows: retry via the tolerant PIL path.
                 for i in np.nonzero(failed)[0]:
@@ -156,6 +163,7 @@ class ImageClassificationDecoder:
             images = list(_pool().map(self._decode_one, payloads))
         else:
             images = [self._decode_one(p) for p in payloads]
+        out = self._lease_out(len(payloads))
         if out is not None:
             return np.stack(images, out=out)
         return np.stack(images)

@@ -50,6 +50,7 @@ import numpy as np
 import pyarrow as pa
 
 from ..obs.registry import default_registry
+from ..utils import leaktrack
 
 __all__ = ["WorkerPool", "columnar_spec", "folder_spec", "RETRYABLE_READ_ERRORS"]
 
@@ -441,6 +442,16 @@ class WorkerPool:
         """Tagged worker result → batch dict (shm read + slot ack, or the
         pickled payload on the fallback path)."""
         if isinstance(out, tuple) and len(out) == 2 and out[0] == "shm":
+            if leaktrack.enabled():
+                # Parent-side token custody starts when the descriptor
+                # lands here and ends at read_batch's ack-put (or
+                # release_token on the abandon path) — the LDT1201 shm
+                # witness half.
+                desc = out[1]
+                leaktrack.track_acquire(
+                    "shm-token",
+                    (self._ring.session, desc["slot"], desc["gen"]),
+                )
             return self._ring.read_batch(out[1], self.buffer_pool)
         if isinstance(out, tuple) and len(out) == 2 and out[0] == "raw":
             if self._ring is not None:

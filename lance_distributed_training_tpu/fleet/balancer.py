@@ -565,47 +565,53 @@ class FleetLoader:
             policy, stop=stop, registry=self.registry,
             interrupt_message="loader closed during connect",
         ):
-            sock = None
             try:
                 sock = socket.create_connection(
                     (host, port), timeout=min(self.timeout_s, 10.0)
                 )
-                sock.settimeout(self.timeout_s)  # handshake recv bound
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                P.send_msg(sock, P.MSG_HELLO, self._hello(
-                    start_step, stripe_index, stripe_count, probe
-                ))
-                msg_type, reply = P.recv_msg(sock)
-                if msg_type == P.MSG_ERROR:
-                    raise P.ProtocolError(
-                        f"data server {addr} rejected handshake: "
-                        f"{reply.get('message', '')}"
-                    )
-                if msg_type != P.MSG_HELLO_OK:
-                    raise P.ProtocolError(
-                        f"expected HELLO_OK, got message type {msg_type}"
-                    )
-                # Striping is NOT downgrade-safe: a pre-v3 server would
-                # ignore the stripe fields and serve EVERY step — silent
-                # duplication across the fleet. Unlike RemoteLoader there
-                # is no version-downgrade retry here, by design.
-                if int(reply.get("version", 0)) < P.STRIPE_MIN_VERSION:
-                    raise P.ProtocolError(
-                        f"data server {addr} speaks protocol "
-                        f"{reply.get('version')} < {P.STRIPE_MIN_VERSION} "
-                        "(no stripe support) — upgrade it before fleeting"
-                    )
-                self._num_steps = int(reply["num_steps"])  # ldt: ignore[LDT1002] -- idempotent plan-length cache: every writer stores the same value for a given epoch
-                sock.settimeout(None)  # streaming phase: no recv deadline
-                sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
-                return sock
-            except P.ProtocolError:
-                if sock is not None:
+                try:
+                    sock.settimeout(self.timeout_s)  # handshake recv bound
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
+                                    1)
+                    P.send_msg(sock, P.MSG_HELLO, self._hello(
+                        start_step, stripe_index, stripe_count, probe
+                    ))
+                    msg_type, reply = P.recv_msg(sock)
+                    if msg_type == P.MSG_ERROR:
+                        raise P.ProtocolError(
+                            f"data server {addr} rejected handshake: "
+                            f"{reply.get('message', '')}"
+                        )
+                    if msg_type != P.MSG_HELLO_OK:
+                        raise P.ProtocolError(
+                            f"expected HELLO_OK, got message type {msg_type}"
+                        )
+                    # Striping is NOT downgrade-safe: a pre-v3 server would
+                    # ignore the stripe fields and serve EVERY step — silent
+                    # duplication across the fleet. Unlike RemoteLoader there
+                    # is no version-downgrade retry here, by design.
+                    if int(reply.get("version", 0)) < P.STRIPE_MIN_VERSION:
+                        raise P.ProtocolError(
+                            f"data server {addr} speaks protocol "
+                            f"{reply.get('version')} < "
+                            f"{P.STRIPE_MIN_VERSION} "
+                            "(no stripe support) — upgrade it before "
+                            "fleeting"
+                        )
+                    self._num_steps = int(reply["num_steps"])  # ldt: ignore[LDT1002] -- idempotent plan-length cache: every writer stores the same value for a given epoch
+                    sock.settimeout(None)  # streaming: no recv deadline
+                    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE,
+                                    1)
+                    return sock
+                except BaseException:
+                    # EVERY failure after the dial closes the socket here —
+                    # the previous typed handlers (ProtocolError,
+                    # ConnectionError/OSError) let a malformed reply
+                    # (KeyError/ValueError) escape with the fd open
+                    # (LDT1201's exception-edge leak).
                     sock.close()
-                raise
+                    raise
             except (ConnectionError, OSError) as exc:
-                if sock is not None:
-                    sock.close()
                 last = exc
                 self.counters.add("connect_retries")
         raise ConnectionError(

@@ -363,9 +363,15 @@ class Coordinator:
 
     def start(self) -> "Coordinator":
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.bind((self.config.host, self.config.port))
-        sock.listen(64)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((self.config.host, self.config.port))
+            sock.listen(64)
+        except BaseException:
+            # A failed bind (port in use) must not leak the listener fd
+            # (LDT1201: the caller retries start(), each leak is forever).
+            sock.close()
+            raise
         self._sock = sock
         self.port = sock.getsockname()[1]
         if self.config.metrics_port is not None:
@@ -378,7 +384,10 @@ class Coordinator:
                     host=self.config.metrics_host,
                     healthz_fn=self._healthz,
                 ).start()
-            except OSError:
+            except BaseException:
+                # Any exporter-start failure (not just a bind OSError)
+                # must retract the listener: the caller has no handle to
+                # a half-initialized service, so the fd would leak.
                 sock.close()
                 self._sock = None
                 raise

@@ -375,7 +375,8 @@ def send_batch_frame(sock: socket.socket, meta: bytes, body) -> int:
     return payload_len
 
 
-def decode_batch(payload, with_lineage: bool = False, pool=None):
+def decode_batch(payload, with_lineage: bool = False,
+                 pool: Optional["BufferPool"] = None):
     """MSG_BATCH payload → ``(step, {name: np.ndarray})``, or with
     ``with_lineage=True`` → ``(step, batch, lineage_or_None)`` (``None``
     when the sender predates — or gated off — the v2 lineage field).
@@ -410,8 +411,11 @@ def decode_batch(payload, with_lineage: bool = False, pool=None):
         ).reshape(shape)
         if pool is not None and nbytes:
             dst = pool.lease(shape, dtype)
-            np.copyto(dst, src)
+            # Ownership parks in `out` before the copy: a failed frame is
+            # discarded whole, and the consumer-owned release (or the
+            # pool's weakref guard) reclaims the page — never a strand.
             out[name] = dst
+            np.copyto(dst, src)
         else:
             out[name] = src.copy()
         offset += nbytes

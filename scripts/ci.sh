@@ -36,12 +36,20 @@
 # consumed stream must stay bit-identical to a fixed-knob control pass,
 # and the LDT_AUTOTUNE_TRACE decision trace must replay deterministically.
 # Stage 8 — the tier-1 verify command from ROADMAP.md, verbatim — run
-# under LDT_LOCK_SANITIZER=1: every threading.Lock/RLock the package
-# creates is wrapped to record actual acquisition orderings, and conftest
-# dumps the witness JSON on exit.
-# Stage 9 — `ldt check --lock-witness` against that witness: the runtime
-# evidence corroborates (or prunes) the static LDT1001 lock-order cycles,
-# and any NEW LDT10xx finding fails the build exactly like stage 1.
+# under LDT_LOCK_SANITIZER=1 AND LDT_LEAK_SANITIZER=1: every
+# threading.Lock/RLock the package creates is wrapped to record actual
+# acquisition orderings, every BufferPool page lease/release and shm slot
+# token handoff is recorded against its acquire site, and conftest dumps
+# both witness JSONs on exit.
+# Stage 9 — `ldt check --lock-witness` against the lock witness: the
+# runtime evidence corroborates (or prunes) the static LDT1001 lock-order
+# cycles, and any NEW LDT10xx finding fails the build exactly like stage 1.
+# Stage 10 — `ldt check --leak-witness` against the lease witness: runtime
+# acquire/release evidence corroborates (or prunes) the static LDT1201
+# ownership findings, and the stage asserts the witness actually
+# corroborates the model (>= 1 runtime site matching a static acquire
+# site — a zero-overlap witness means the sanitizer hooks or the
+# ownership model silently rotted).
 set -e
 cd "$(dirname "$0")/.."
 
@@ -137,10 +145,11 @@ echo "== autotune smoke (closed-loop controller on live /metrics) =="
 # deterministically-replayable decision trace.
 timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/autotune_smoke.py
 
-echo "== tier-1 tests (lock sanitizer on) =="
+echo "== tier-1 tests (lock + leak sanitizers on) =="
 WITNESS=/tmp/_ldt_lock_witness.json
-rm -f "$WITNESS"
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu LDT_LOCK_SANITIZER=1 LDT_LOCK_WITNESS_PATH="$WITNESS" python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+LEAK_WITNESS=/tmp/_ldt_leak_witness.json
+rm -f "$WITNESS" "$LEAK_WITNESS"
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu LDT_LOCK_SANITIZER=1 LDT_LOCK_WITNESS_PATH="$WITNESS" LDT_LEAK_SANITIZER=1 LDT_LEAK_WITNESS_PATH="$LEAK_WITNESS" python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
 echo "== lock-order witness cross-check =="
@@ -149,3 +158,13 @@ echo "== lock-order witness cross-check =="
 # statically-inferred cycle the run contradicts is marked witness_pruned.
 test -s "$WITNESS" || { echo "missing lock witness $WITNESS"; exit 1; }
 python scripts/ldt_check.py --lock-witness "$WITNESS"
+
+echo "== resource-lease witness cross-check =="
+# The instrumented run's pool-lease / shm-token evidence, fed back into
+# the LDT1201 ownership gate — and an assertion that the witness actually
+# overlaps the static model: at least one runtime acquire site must match
+# a static acquire record, or the corroboration loop is dead machinery.
+test -s "$LEAK_WITNESS" || { echo "missing leak witness $LEAK_WITNESS"; exit 1; }
+python scripts/ldt_check.py --leak-witness "$LEAK_WITNESS" | tee /tmp/_leakcheck.log
+grep -E 'leak witness: [1-9][0-9]*/[0-9]+ runtime sites match' /tmp/_leakcheck.log \
+  || { echo "leak witness corroborated no static acquire site"; exit 1; }

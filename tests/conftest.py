@@ -56,7 +56,7 @@ def pytest_configure(config):
     # before collection imports any package module — so module-level locks
     # (native/jpeg.py, data/buffers.py, obs/spans.py) are instrumented too.
     if os.environ.get("LDT_LOCK_SANITIZER") == "1":
-        _load_lockorder().install()
+        _load_util("lockorder").install()
 
 
 def pytest_unconfigure(config):
@@ -64,25 +64,35 @@ def pytest_unconfigure(config):
         # Dump unconditionally (not gated on installed()): whatever the
         # suite recorded is the witness, even if a unit test toggled the
         # shim along the way (they snapshot/restore, belt and braces).
-        lockorder = _load_lockorder()
+        lockorder = _load_util("lockorder")
         path = lockorder.dump()
         lockorder.uninstall()
         sys.stderr.write(f"\n[lockorder] witness written to {path}\n")
+    if os.environ.get("LDT_LEAK_SANITIZER") == "1":
+        # Resource-lease witness (LDT1201's evidence half): the buffer
+        # plane's leaktrack hooks recorded every pool-page lease/release
+        # and shm-token handoff across the suite; whatever is still
+        # outstanding NOW is a leak by definition — dump for
+        # `ldt check --leak-witness`.
+        leaktrack = _load_util("leaktrack")
+        path = leaktrack.dump()
+        sys.stderr.write(f"\n[leaktrack] witness written to {path}\n")
 
 
-def _load_lockorder():
-    """Load ``utils/lockorder.py`` WITHOUT importing the package __init__
-    (which would create the module-level locks before the shim exists,
-    leaving them uninstrumented). Registered under the canonical dotted
-    name so a later in-test import shares the same recorder state."""
+def _load_util(stem):
+    """Load a ``utils/<stem>.py`` sanitizer WITHOUT importing the package
+    __init__ (which would create module-level locks before the lockorder
+    shim exists, leaving them uninstrumented — and eagerly import jax).
+    Registered under the canonical dotted name so a later in-test import
+    shares the same recorder state."""
     import importlib.util
 
-    name = "lance_distributed_training_tpu.utils.lockorder"
+    name = f"lance_distributed_training_tpu.utils.{stem}"
     if name in sys.modules:
         return sys.modules[name]
     path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "lance_distributed_training_tpu", "utils", "lockorder.py",
+        "lance_distributed_training_tpu", "utils", f"{stem}.py",
     )
     spec = importlib.util.spec_from_file_location(name, path)
     module = importlib.util.module_from_spec(spec)

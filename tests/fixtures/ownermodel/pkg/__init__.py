@@ -1,0 +1,19 @@
+"""Seeded fixture package for the LDT1201-1203/LDT1301 ownership and
+purity rules.
+
+Never imported — only parsed by the analyzer. The seeds (asserted exactly
+by ``tests/test_analysis.py``):
+
+* ``leaky.py`` — a pool lease that leaks on the exception edge of an
+  intervening call (LDT1201), a generator holding a lease across a
+  ``yield`` with no try/finally (LDT1201, generator-close channel), a
+  slot token put back twice (LDT1202), and a socket ``shutdown`` after
+  ``close`` (LDT1203);
+* ``content.py`` — ``time.time()`` inside a declared content path and a
+  pop off a queue-typed attribute (LDT1301 × 2), next to a seeded-RNG
+  negative control;
+* ``clean.py`` — negative controls that must stay silent: try/finally
+  release, transfer by return / queue put / the ``_publish`` handle-swap,
+  the guarded ``except BaseException: if sock is not None: close`` dial
+  pattern, and a ``with``-managed acquisition.
+"""

@@ -1,0 +1,64 @@
+"""Fixture: negative controls — correct ownership on every path."""
+
+import socket
+import threading
+
+from .pool import Pool
+
+
+def finally_release(pool: "Pool", payloads):
+    page = pool.lease(len(payloads))
+    try:
+        return decode(payloads, page)  # noqa: F821
+    finally:
+        pool.release(page)
+
+
+def transfer_by_return(pool: "Pool", n):
+    page = pool.lease(n)
+    return page
+
+
+def transfer_by_queue(pool: "Pool", q, n):
+    page = pool.lease(n)
+    q.put(page)
+
+
+def managed(host):
+    with socket.create_connection((host, 80)) as sock:
+        return handshake(sock)  # noqa: F821
+
+
+def guarded_cleanup(host):
+    sock = None
+    try:
+        sock = socket.create_connection((host, 80))
+        handshake(sock)  # noqa: F821
+        return sock
+    except BaseException:
+        if sock is not None:
+            sock.close()
+        raise
+
+
+class Holder:
+    """The ``_publish``/``_close`` handle-swap idiom: ``dial`` transfers
+    the socket through ``_publish``, ``close`` owns teardown."""
+
+    def __init__(self):
+        self._conn = None
+        self._lock = threading.Lock()
+
+    def _publish(self, sock):
+        with self._lock:
+            self._conn = sock
+
+    def dial(self, host):
+        sock = socket.create_connection((host, 80))
+        self._publish(sock)
+
+    def close(self):
+        with self._lock:
+            conn, self._conn = self._conn, None
+        if conn is not None:
+            conn.close()

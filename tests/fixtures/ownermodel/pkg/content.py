@@ -1,0 +1,21 @@
+"""Fixture: content-purity seeds (declared content path in the tests)."""
+
+import queue
+import time
+
+import numpy as np
+
+
+def build_plan(n, seed):
+    # Negative control: a SEEDED generator is pure — same seed, same plan.
+    order = np.random.default_rng(seed).permutation(n)
+    jitter = time.time()  # seeded LDT1301: wall clock shaping the plan
+    return [(int(i), jitter) for i in order]
+
+
+class Assembler:
+    def __init__(self, depth):
+        self.q = queue.Queue(maxsize=depth)
+
+    def next_batch(self):
+        return self.q.get_nowait()  # seeded LDT1301: arrival order

@@ -1,0 +1,32 @@
+"""Fixture: planted ownership/lifecycle violations (parsed, never run)."""
+
+import socket
+
+from .pool import Pool, Ring
+
+
+def leak_on_exception(pool: "Pool", payloads):
+    page = pool.lease(len(payloads))
+    filled = decode(payloads, page)  # noqa: F821 — may raise: page leaks
+    pool.release(page)
+    return filled
+
+
+def leaky_generator(pool: "Pool", items):
+    page = pool.lease(8)
+    for item in items:
+        fill(page, item)  # noqa: F821
+        yield item  # close() here raises GeneratorExit: page strands
+    pool.release(page)
+
+
+def double_put(ring: "Ring", q):
+    tok = ring._acquire()
+    q.put(tok)
+    q.put(tok)  # seeded LDT1202: the slot now has two owners
+
+
+def shutdown_after_close(host):
+    sock = socket.create_connection((host, 80))
+    sock.close()
+    sock.shutdown(2)  # seeded LDT1203: the handle is no longer owned

@@ -1,0 +1,19 @@
+"""Fixture resource types — the targets of the test vocabulary."""
+
+
+class Pool:
+    """Stands in for the real BufferPool (vocabulary: ``Pool.lease`` →
+    ``release``)."""
+
+    def lease(self, n):
+        return bytearray(n)
+
+    def release(self, page):
+        return True
+
+
+class Ring:
+    """Stands in for the shm ring (vocabulary: ``Ring._acquire`` → put)."""
+
+    def _acquire(self):
+        return (0, 0, 0)
