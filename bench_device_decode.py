@@ -1,0 +1,259 @@
+"""Device-side decode A/B — the r12 acceptance benchmark
+(BENCH_DEVICE_DECODE_r09).
+
+Two arms over one shared synthetic columnar corpus, INTERLEAVED pass by
+pass in one process (the BENCH_ZC_r06 / BENCH_H2D_r07 discipline: this
+box's run-to-run throughput drift cancels out of the within-pair ratio):
+
+* ``host`` — the ``--no_device_decode`` arm: the exact r11 pipeline
+  (native libjpeg full decode + fixed-point resize on producer threads,
+  finished pixels to the consumer);
+* ``device`` — the entropy split: producers run ONLY the Huffman/entropy
+  half (``jpeg_read_coefficients`` via the ABI-v3 extractor) and the
+  consumer finishes dequant + IDCT + upsample + color + resize as the
+  jitted kernel (``ops/jpeg_device.py``), executed to completion inside
+  the measured pass.
+
+Both arms feed the same fixed synthetic jitted "train step" (a calibrated
+matmul chain, executed to completion per batch), so loader-stall% means
+the same thing in both: the share of the pass the consumer spent waiting
+on the producer side. Honest-bench notes: CPU basis — the "device" here
+is the XLA:CPU backend, so the kernel competes for the same cores the
+host arm decodes on; on a real TPU the dense half leaves the host
+entirely and the split can only widen. The kernel path is pure jit with
+no host callbacks (LDT101/LDT1301-pinned), i.e. the TPU run is the same
+code.
+
+Acceptance (ISSUE 12): device arm >= 1.25x host images/sec OR a >= 15
+point loader-stall cut; device-arm batch digests bit-identical across
+repeated passes; host-vs-device parity within the pinned envelope
+(``HOST_PARITY_MAX_ABS_DIFF``), measured value recorded.
+
+Usage::
+
+    python bench_device_decode.py                    # full run
+    BENCH_SMALL=1 python bench_device_decode.py      # tiny smoke
+    BENCH_DD_ROWS=4096 BENCH_DD_PASSES=5 python bench_device_decode.py
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+SMALL = bool(os.environ.get("BENCH_SMALL"))
+ROWS = int(os.environ.get("BENCH_DD_ROWS") or 0) or (256 if SMALL else 2048)
+PASSES = int(os.environ.get("BENCH_DD_PASSES") or 0) or (2 if SMALL else 3)
+BATCH = 16 if SMALL else 64
+SRC_SIZE = 96 if SMALL else 256   # source JPEG side (< 2x target: no draft,
+# so both arms decode at full scale and the parity envelope is tight)
+OUT_SIZE = 64 if SMALL else 224   # decode target
+PRODUCERS = 2
+OUT_PATH = os.environ.get("BENCH_DD_OUT") or "BENCH_DEVICE_DECODE_r09.json"
+
+
+def main() -> None:
+    from _bench_init import force_cpu
+
+    force_cpu(1)
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from lance_distributed_training_tpu.data.authoring import (
+        create_synthetic_classification_dataset,
+    )
+    from lance_distributed_training_tpu.data.decode import (
+        ImageClassificationDecoder,
+    )
+    from lance_distributed_training_tpu.data.device_decode import (
+        CoeffImageDecoder,
+    )
+    from lance_distributed_training_tpu.data.pipeline import (
+        make_train_pipeline,
+    )
+    from lance_distributed_training_tpu.ops.jpeg_device import (
+        HOST_PARITY_MAX_ABS_DIFF,
+        make_batch_transform,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="ldt-bench-dd-")
+    ds = create_synthetic_classification_dataset(
+        os.path.join(tmp, "ds"), rows=ROWS, num_classes=10,
+        image_size=SRC_SIZE, fragment_size=max(ROWS // 4, 64),
+        unique_images=64, seed=11,
+    )
+
+    # The fixed consumer step: a strided sub-sample reduction, jitted —
+    # deliberately near-free, so the measurement isolates the decode
+    # pipeline plus the dense half's placement (the bench_zero_copy
+    # "loader_only" basis: the question is where decode runs, not how fast
+    # a model trains — even a full u8 sum costs ~100 ms/batch on this
+    # box's XLA:CPU and would mask the stall signal). The device arm's
+    # kernel still executes in full: the transform's jit call materialises
+    # the whole image array before this step touches a slice of it.
+    @jax.jit
+    def step(images_u8):
+        return jnp.sum(images_u8[:, ::32, ::32, :], dtype=jnp.int32)
+
+    transform = make_batch_transform(OUT_SIZE)
+
+    def make_loader(device: bool):
+        decode = (
+            CoeffImageDecoder(image_size=OUT_SIZE)
+            if device else ImageClassificationDecoder(image_size=OUT_SIZE)
+        )
+        return make_train_pipeline(
+            ds, "batch", BATCH, 0, 1, decode, producers=PRODUCERS,
+        )
+
+    def run_pass(device: bool, digest: bool = False):
+        """One full epoch: returns (wall_s, stall_s, steps, digests)."""
+        loader = make_loader(device)
+        digests = []
+        stall = 0.0
+        steps = 0
+        it = iter(loader)
+        t_pass = time.perf_counter()
+        while True:
+            t0 = time.perf_counter()
+            batch = next(it, None)
+            stall += time.perf_counter() - t0
+            if batch is None:
+                break
+            batch = transform(batch)  # no-op for the host (pixel) arm
+            loss = step(batch["image"])
+            jax.block_until_ready(loss)
+            if digest:
+                digests.append(hashlib.sha256(
+                    np.asarray(batch["image"]).tobytes()
+                ).hexdigest())
+            steps += 1
+        wall = time.perf_counter() - t_pass
+        return wall, stall, steps, digests
+
+    # Warm the jit caches OUTSIDE the measured passes (both arms pay
+    # compile once; neither pays it inside the timing).
+    for device in (False, True):
+        loader = make_loader(device)
+        first = next(iter(loader), None)
+        jax.block_until_ready(step(transform(first)["image"]))
+
+    # Parity: first batch of each arm over the identical plan.
+    host_first = next(iter(make_loader(False)))
+    dev_raw = next(iter(make_loader(True)))
+    dev_first = transform(dev_raw)
+    parity = int(np.abs(
+        np.asarray(dev_first["image"], np.int32)
+        - host_first["image"].astype(np.int32)
+    ).max())
+
+    # Per-stage micro-costs (measured, not quoted): why CPU-basis wall
+    # regresses while the stall collapses — XLA:CPU runs the dense half
+    # slower than libjpeg's IFAST path while timesharing the same core;
+    # the host keeps only the entropy_extract share.
+    from lance_distributed_training_tpu.ops.jpeg_device import (
+        decode_coeff_batch,
+    )
+
+    def _time(fn, reps=3):
+        fn()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return round((time.perf_counter() - t0) / reps * 1000, 1)
+
+    kernel_args = tuple(dev_raw[k] for k in (
+        "jpeg_coef_y", "jpeg_coef_cb", "jpeg_coef_cr", "jpeg_quant",
+        "jpeg_geom",
+    ))
+    micro = {
+        "device_kernel_xla_cpu": _time(lambda: jax.block_until_ready(
+            decode_coeff_batch(*kernel_args, out_size=OUT_SIZE)
+        )),
+    }
+
+    arms = {"host": dict(wall=0.0, stall=0.0, steps=0),
+            "device": dict(wall=0.0, stall=0.0, steps=0)}
+    digest_passes = []
+    for pass_idx in range(PASSES):
+        for name, device in (("host", False), ("device", True)):
+            wall, stall, steps, digests = run_pass(
+                device, digest=device,
+            )
+            arms[name]["wall"] += wall
+            arms[name]["stall"] += stall
+            arms[name]["steps"] += steps
+            if device:
+                digest_passes.append(digests)
+            print(json.dumps({
+                "pass": pass_idx, "arm": name, "wall_s": round(wall, 3),
+                "stall_s": round(stall, 3), "steps": steps,
+            }), flush=True)
+
+    digests_identical = all(d == digest_passes[0] for d in digest_passes)
+    out = {}
+    for name, a in arms.items():
+        rate = ROWS * PASSES / a["wall"] if a["wall"] else 0.0
+        stall_pct = 100.0 * a["stall"] / a["wall"] if a["wall"] else 0.0
+        out[name] = {"images_per_sec": round(rate, 2),
+                     "stall_pct": round(stall_pct, 2),
+                     "wall_s": round(a["wall"], 3)}
+    speedup = (
+        out["device"]["images_per_sec"] / out["host"]["images_per_sec"]
+        if out["host"]["images_per_sec"] else 0.0
+    )
+    stall_cut = out["host"]["stall_pct"] - out["device"]["stall_pct"]
+    passed = (
+        (speedup >= 1.25 or stall_cut >= 15.0)
+        and digests_identical
+        and parity <= HOST_PARITY_MAX_ABS_DIFF
+    )
+    record = {
+        "bench": "device_decode_entropy_split",
+        "arms": out,
+        "speedup_device_over_host": round(speedup, 3),
+        "stall_cut_points": round(stall_cut, 2),
+        "parity_max_abs_diff": parity,
+        "parity_envelope": HOST_PARITY_MAX_ABS_DIFF,
+        "device_digests_bit_identical_across_passes": digests_identical,
+        "digest_passes": len(digest_passes),
+        "rows": ROWS, "passes": PASSES, "batch": BATCH,
+        "src_size": SRC_SIZE, "out_size": OUT_SIZE,
+        "producers": PRODUCERS,
+        "micro_ms_per_batch": micro,
+        "basis": (
+            f"interleaved_passes_cpu_{os.cpu_count()}core_single_process_"
+            "light_step; the 'device' arm's jitted kernel runs on XLA:CPU "
+            "and timeshares the SAME core(s) the host arm decodes on, so "
+            "CPU-basis wall CHARGES the device arm for work a real "
+            "accelerator absorbs — the stall-cut clause is the CPU-basis "
+            "signal (the BENCH_H2D_r07 precedent), the images/sec clause "
+            "the accelerator-basis one. The kernel is pure jit with no "
+            "host callbacks (LDT101/LDT1301-pinned): the TPU run is this "
+            "exact code path with the dense half off the host entirely"
+        ),
+        "acceptance": (
+            "device >= 1.25x host images/sec OR >= 15-point stall cut; "
+            "device digests bit-identical across passes; parity within "
+            "the pinned envelope"
+        ),
+        "passed": passed,
+    }
+    print(json.dumps(record, indent=2), flush=True)
+    with open(OUT_PATH, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {OUT_PATH}", file=sys.stderr)
+    if not passed:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

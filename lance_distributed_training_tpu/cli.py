@@ -95,6 +95,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable the recycled decode/receive buffer pool — "
                         "every batch faults a fresh allocation (pre-r6 "
                         "behavior; bufpool_* metrics stay at zero)")
+    dd = p.add_mutually_exclusive_group()
+    dd.add_argument("--device_decode", action="store_true",
+                    help="split JPEG decode at the entropy boundary: the "
+                         "host does only the Huffman/entropy half and "
+                         "ships half-decoded coefficient pages; dequant + "
+                         "IDCT + upsample + color + resize run as a pure "
+                         "jitted device kernel fused ahead of the step "
+                         "(classification only; falls back to the host "
+                         "path with a warning if the native extractor is "
+                         "unavailable)")
+    dd.add_argument("--no_device_decode", action="store_true",
+                    help="force the host pixel-decode path — the exact "
+                         "r11 pipeline, the A/B control arm for "
+                         "--device_decode (this is also the default)")
     p.add_argument("--data_service", type=str, default=None, metavar="HOST:PORT",
                    help="stream decoded batches from a running `ldt "
                         "serve-data` service instead of decoding locally "
@@ -201,11 +215,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fsdp", action="store_true",
                    help="fully shard params + optimizer state over the "
                         "'data' axis (ZeRO-3 equivalent)")
-    p.add_argument("--zero", action="store_true",
-                   help="shard ONLY the optimizer state over the 'data' "
-                        "axis, params replicated (ZeRO-1: optimizer memory "
-                        "scales 1/N with the mesh, no per-layer gathers; "
-                        "mutually exclusive with --fsdp)")
+    p.add_argument("--zero", nargs="?", type=int, const=1, default=0,
+                   choices=[1, 2], metavar="LEVEL",
+                   help="ZeRO gradient/optimizer sharding over the 'data' "
+                        "axis, params replicated. Bare --zero (or "
+                        "--zero 1) = ZeRO-1: shard only the optimizer "
+                        "moments; --zero 2 = ZeRO-2: additionally shard "
+                        "the gradient-accumulation buffer (--grad_accum) "
+                        "and reduce-scatter the step's gradients into the "
+                        "shards. Both are mutually exclusive with --fsdp, "
+                        "which already shards everything")
     p.add_argument("--num_experts", type=int, default=0,
                    help=">0: switch-MoE transformer blocks; experts shard "
                         "over the 'model' mesh axis (expert parallelism)")
@@ -273,6 +292,11 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--no_buffer_pool", action="store_true",
                    help="disable the recycled decode-buffer pool (every "
                         "batch faults a fresh allocation)")
+    p.add_argument("--device_decode", action="store_true",
+                   help="serve half-decoded JPEG coefficient pages "
+                        "(entropy-only host decode) instead of finished "
+                        "pixels — trainers must also run --device_decode "
+                        "(the HELLO is skew-checked); classification only")
     p.add_argument("--queue_depth", type=int, default=4,
                    help="bounded per-client batch queue (backpressure)")
     p.add_argument("--handshake_timeout_s", type=float, default=30.0,
@@ -443,6 +467,7 @@ def serve_main(argv=None) -> dict:
         num_workers=args.num_workers,
         shm_workers=not args.no_shm_workers,
         buffer_pool=not args.no_buffer_pool,
+        device_decode=args.device_decode,
         queue_depth=args.queue_depth,
         handshake_timeout_s=args.handshake_timeout_s,
         read_retries=args.read_retries,
@@ -588,6 +613,7 @@ def main(argv=None) -> dict:
         num_workers=args.num_workers,
         shm_workers=not args.no_shm_workers,
         buffer_pool=not args.no_buffer_pool,
+        device_decode=args.device_decode and not args.no_device_decode,
         data_service_addr=args.data_service,
         coordinator_addr=args.coordinator,
         no_ddp=args.no_ddp,

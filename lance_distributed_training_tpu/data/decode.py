@@ -29,21 +29,57 @@ import numpy as np
 import pyarrow as pa
 
 __all__ = ["ImageClassificationDecoder", "decode_tensor_image",
-           "numeric_decoder", "decoder_for_task"]
+           "numeric_decoder", "decoder_for_task", "shutdown_decode_pool"]
 
 _POOL: Optional[ThreadPoolExecutor] = None
+_POOL_ATEXIT_REGISTERED = False
 
 
 def _pool() -> ThreadPoolExecutor:
-    global _POOL
+    global _POOL, _POOL_ATEXIT_REGISTERED
     if _POOL is None:
         import os
 
+        # Reap at interpreter exit, mirroring WorkerPool's finalize
+        # discipline (LDT1201 guards the pool via the decode-pool resource
+        # kind): without this the executor's own non-daemon threads hold
+        # the interpreter on the concurrent.futures atexit join, and a
+        # wedged PIL decode would hang shutdown forever. Registered ONCE,
+        # BEFORE the executor exists (shutdown of a None pool no-ops), so
+        # no raise can strand an unregistered pool and shutdown/respawn
+        # cycles never stack duplicate atexit entries.
+        if not _POOL_ATEXIT_REGISTERED:
+            import atexit
+
+            atexit.register(shutdown_decode_pool)
+            _POOL_ATEXIT_REGISTERED = True
         _POOL = ThreadPoolExecutor(
             max_workers=max(4, (os.cpu_count() or 8) // 2),
             thread_name_prefix="ldt-decode",
         )
     return _POOL
+
+
+def shutdown_decode_pool() -> None:
+    """Shut the shared decode ThreadPoolExecutor down (idempotent; also
+    registered atexit on first use). The next ``_pool()`` call lazily
+    spawns a fresh one, so tests and long-lived embedders can reap it
+    between phases."""
+    global _POOL
+    pool, _POOL = _POOL, None
+    if pool is not None:
+        pool.shutdown(wait=False, cancel_futures=True)
+
+
+def _pixel_bytes_counter():
+    """``decode_pixel_bytes_total`` — finished-pixel bytes the HOST path
+    produces per batch; against ``decode_coeff_bytes_total`` (the
+    device-decode half, :mod:`.device_decode`) the wire-traffic trade of
+    the entropy split is scrapeable on /metrics. Looked up lazily so the
+    decoder stays picklable across worker processes."""
+    from ..obs.registry import default_registry
+
+    return default_registry().counter("decode_pixel_bytes_total")
 
 
 class ImageClassificationDecoder:
@@ -195,6 +231,7 @@ class ImageClassificationDecoder:
         self, batch: Union[pa.RecordBatch, pa.Table]
     ) -> dict[str, np.ndarray]:
         images = self.decode_column(batch.column(self.image_column))
+        _pixel_bytes_counter().inc(images.nbytes)
         out = {"image": images}
         if self.label_column is not None:
             out["label"] = np.asarray(
@@ -244,21 +281,39 @@ class ImageTextDecoder:
         out["image"] = self._image.decode_column(
             table.column(self.image_column)
         )
+        _pixel_bytes_counter().inc(out["image"].nbytes)
         return out
 
 
 def decoder_for_task(task_type: str, image_size: int = 224,
-                     buffer_pool=None):
+                     buffer_pool=None, device_decode: bool = False):
     """THE task-type → decode-hook dispatch, shared by the trainer and the
     data-service server. Keeping it in one place is what upholds the
     service's bit-identical-batches guarantee: a decoder change that only
     landed on one side would silently train on different tensors.
     ``buffer_pool`` (data/buffers.BufferPool) makes the image decoders
     write into recycled pages; output values are bit-identical either way
-    (the guarantee extends to the buffer plane — tests pin it)."""
+    (the guarantee extends to the buffer plane — tests pin it).
+
+    ``device_decode`` selects the entropy-split decoder
+    (:mod:`.device_decode`): the host emits half-decoded coefficient pages
+    and the dense back half runs as the jitted device kernel
+    (:mod:`..ops.jpeg_device`) — classification only; degrades to the
+    pixel path with one warning when the native extractor is absent."""
     if task_type == "classification":
+        if device_decode:
+            from .device_decode import coeff_decoder_or_fallback
+
+            return coeff_decoder_or_fallback(
+                image_size=image_size, buffer_pool=buffer_pool
+            )
         return ImageClassificationDecoder(
             image_size=image_size, buffer_pool=buffer_pool
+        )
+    if device_decode:
+        raise ValueError(
+            "device_decode currently supports task_type='classification' "
+            f"only (the JPEG entropy split), got {task_type!r}"
         )
     if task_type in ("masked_lm", "causal_lm"):
         return numeric_decoder  # zero-copy Arrow→numpy: nothing to pool

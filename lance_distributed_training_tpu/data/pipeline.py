@@ -194,12 +194,18 @@ class DataPipeline:
         return depth
 
     def tunables(self):
-        """Autotune registration surface (tune/): the prefetch depth."""
-        return [Tunable(
+        """Autotune registration surface (tune/): the prefetch depth,
+        plus whatever the decode hook itself exposes (the coefficient-page
+        chunk granularity for the device-decode decoder)."""
+        out = [Tunable(
             "prefetch", lambda: self.prefetch, self.set_prefetch,
             lo=1, hi=16,
             doc="decoded host batches buffered ahead of the consumer",
         )]
+        decoder = getattr(self.decode_fn, "tunables", None)
+        if decoder is not None:
+            out.extend(decoder())
+        return out
 
     def state_dict(self) -> dict:
         return {"step": int(self._yielded)}
@@ -630,11 +636,15 @@ class MapStylePipeline:
         return depth
 
     def tunables(self):
-        return [Tunable(
+        out = [Tunable(
             "prefetch", lambda: self.prefetch, self.set_prefetch,
             lo=1, hi=16,
             doc="decoded host batches buffered ahead of the consumer",
         )]
+        decoder = getattr(self.decode_fn, "tunables", None)
+        if decoder is not None:
+            out.extend(decoder())
+        return out
 
     def set_epoch(self, epoch: int) -> None:
         if epoch != self.epoch:

@@ -338,6 +338,7 @@ class FleetLoader:
         timeout_s: float = 120.0,
         task_type: Optional[str] = None,
         image_size: Optional[int] = None,
+        device_decode: Optional[bool] = None,
         registry: Optional[MetricsRegistry] = None,
         buffer_pool=None,
         stripe_queue_depth: int = 2,
@@ -362,6 +363,7 @@ class FleetLoader:
         self.timeout_s = timeout_s
         self.task_type = task_type
         self.image_size = image_size
+        self.device_decode = device_decode
         self.registry = registry if registry is not None else default_registry()
         self.counters = ServiceCounters(prefix="fleet", registry=self.registry)
         self.buffer_pool = buffer_pool
@@ -547,6 +549,7 @@ class FleetLoader:
             probe=probe,
             task_type=self.task_type,
             image_size=self.image_size,
+            device_decode=self.device_decode,
         )
 
     def _dial_member(self, addr: str, start_step: int, stripe_index: int,

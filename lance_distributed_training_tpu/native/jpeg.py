@@ -16,12 +16,20 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-__all__ = ["batch_decode_jpeg", "batch_decode_jpeg_arrow", "native_available"]
+__all__ = [
+    "batch_decode_jpeg",
+    "batch_decode_jpeg_arrow",
+    "batch_probe_jpeg",
+    "batch_extract_coeffs",
+    "native_available",
+    "payload_pointers",
+    "arrow_pointers",
+]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "ldt_decode.cpp")
 _LIB_PATH = os.path.join(_HERE, "_ldt_decode.so")
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 # Fallback build target when the package directory is read-only (system
 # pip installs): a per-user cache, keyed by ABI so upgrades never collide.
 _CACHE_LIB = os.path.join(
@@ -114,6 +122,31 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int,
             ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int,
+        ]
+        lib.ldt_probe_batch.restype = ctypes.c_int
+        lib.ldt_probe_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.ldt_extract_coeffs.restype = ctypes.c_int
+        lib.ldt_extract_coeffs.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_int,
+            ctypes.c_int,  # yb_h
+            ctypes.c_int,  # yb_w
+            ctypes.c_int,  # cb_h
+            ctypes.c_int,  # cb_w
+            ctypes.POINTER(ctypes.c_int16),
+            ctypes.POINTER(ctypes.c_int16),
+            ctypes.POINTER(ctypes.c_int16),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_int,
         ]
@@ -229,3 +262,135 @@ def batch_decode_jpeg_arrow(
         n_threads,
     )
     return out, failed
+
+
+# -- entropy-boundary split (ABI v3) ----------------------------------------
+#
+# The host half of device-side decode: probe geometry, then extract the
+# quantized DCT coefficient pages (jpeg_read_coefficients = the inherently
+# sequential Huffman/entropy work ONLY). The dense back half — dequant,
+# IDCT, chroma upsample, color convert, resize — is the jitted kernel in
+# ops/jpeg_device.py. Both wrappers take a (srcs, lens, keepalive) pointer
+# triple from payload_pointers/arrow_pointers so the arrow path never
+# materialises per-row Python bytes.
+
+
+def payload_pointers(payloads: Sequence[bytes]):
+    """Pointer arrays over a list of JPEG byte strings. Returns
+    ``(srcs, lens, n, keepalive)``; ``keepalive`` must outlive the call."""
+    n = len(payloads)
+    srcs = (ctypes.c_char_p * n)(*payloads)
+    lens = (ctypes.c_size_t * n)(*[len(p) for p in payloads])
+    return srcs, lens, n, payloads
+
+
+def arrow_pointers(binary_array):
+    """Pointer arrays straight over an Arrow binary column's buffers —
+    zero-copy (no per-row ``bytes``); rows must be non-null."""
+    import pyarrow as pa
+
+    n = len(binary_array)
+    buffers = binary_array.buffers()  # [validity, offsets, values]
+    if buffers[0] is not None and binary_array.null_count:
+        raise ValueError("null image rows are not decodable")
+    width = 8 if pa.types.is_large_binary(binary_array.type) else 4
+    raw = np.frombuffer(
+        buffers[1], dtype=np.int64 if width == 8 else np.int32,
+        count=binary_array.offset + n + 1,
+    )
+    offsets = raw[binary_array.offset : binary_array.offset + n + 1]
+    base = buffers[2].address
+    srcs = (ctypes.c_char_p * n)(
+        *[ctypes.c_char_p(base + int(offsets[i])) for i in range(n)]
+    )
+    lens = (ctypes.c_size_t * n)(
+        *[int(offsets[i + 1] - offsets[i]) for i in range(n)]
+    )
+    # Keep the Arrow buffers (and through them the column) alive for as
+    # long as the pointer arrays are in use.
+    return srcs, lens, n, buffers
+
+
+def batch_probe_jpeg(pointers) -> tuple[np.ndarray, np.ndarray]:
+    """Header-only parse of a batch: ``(geom [N,4] i32, failed [N] u8)``
+    where geom rows are ``(width, height, ncomp, coeff_ok)``. ``coeff_ok``
+    is 1 when the image is extractable into the canonical coefficient page
+    (grayscale or 4:2:0 YCbCr)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native decoder unavailable")
+    srcs, lens, n, keepalive = pointers
+    geom = np.zeros((n, 4), dtype=np.int32)
+    failed = np.zeros(n, dtype=np.uint8)
+    if n:
+        lib.ldt_probe_batch(
+            ctypes.cast(srcs, ctypes.POINTER(ctypes.c_char_p)),
+            ctypes.cast(lens, ctypes.POINTER(ctypes.c_size_t)),
+            n,
+            geom.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            failed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+    del keepalive
+    return geom, failed
+
+
+def _check_page(arr: np.ndarray, shape: tuple, dtype, name: str) -> None:
+    """Validate a caller-supplied coefficient page before handing its
+    pointer to C (same contract as :func:`_check_out`: exact shape/dtype,
+    C-contiguous, writeable — anything else is a silent OOB write)."""
+    if arr.dtype != dtype:
+        raise ValueError(f"{name} must be {np.dtype(dtype)}, got {arr.dtype}")
+    if tuple(arr.shape) != shape:
+        raise ValueError(f"{name} shape {tuple(arr.shape)} != {shape}")
+    if not arr.flags["C_CONTIGUOUS"] or not arr.flags["WRITEABLE"]:
+        raise ValueError(f"{name} must be C-contiguous and writeable")
+
+
+def batch_extract_coeffs(
+    pointers,
+    yb_h: int,
+    yb_w: int,
+    cb_h: int,
+    cb_w: int,
+    coef_y: np.ndarray,
+    coef_cb: np.ndarray,
+    coef_cr: np.ndarray,
+    quant: np.ndarray,
+    geom: np.ndarray,
+    n_threads: int = 0,
+) -> np.ndarray:
+    """Entropy-decode a batch into caller-provided canonical pages.
+
+    Pages may be pooled (``data/buffers.py``) and MUST be zeroed by the
+    caller — padding blocks are never written by the extractor. Returns the
+    per-image ``failed`` mask (corrupt or non-canonical sampling; those
+    rows' page contents are unspecified but in-bounds)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native decoder unavailable")
+    srcs, lens, n, keepalive = pointers
+    _check_page(coef_y, (n, yb_h, yb_w, 64), np.int16, "coef_y")
+    _check_page(coef_cb, (n, cb_h, cb_w, 64), np.int16, "coef_cb")
+    _check_page(coef_cr, (n, cb_h, cb_w, 64), np.int16, "coef_cr")
+    _check_page(quant, (n, 3, 64), np.int32, "quant")
+    _check_page(geom, (n, 6), np.int32, "geom")
+    failed = np.zeros(n, dtype=np.uint8)
+    if n:
+        lib.ldt_extract_coeffs(
+            ctypes.cast(srcs, ctypes.POINTER(ctypes.c_char_p)),
+            ctypes.cast(lens, ctypes.POINTER(ctypes.c_size_t)),
+            n,
+            yb_h,
+            yb_w,
+            cb_h,
+            cb_w,
+            coef_y.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+            coef_cb.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+            coef_cr.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+            quant.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            geom.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            failed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n_threads,
+        )
+    del keepalive
+    return failed

@@ -205,7 +205,223 @@ int ldt_decode_batch_offsets(const uint8_t* data, const int64_t* offsets,
   return failures.load();
 }
 
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Entropy-boundary split (ABI v3): the host half of device-side decode.
+//
+// `ldt_probe_batch` parses only the JPEG headers (geometry + sampling);
+// `ldt_extract_coeffs` runs jpeg_read_coefficients — the inherently
+// sequential Huffman/entropy decode, with DC prediction and de-zigzag
+// resolved by libjpeg — and copies the quantized DCT blocks into
+// caller-provided canonical coefficient pages. Everything dense that used
+// to follow here (dequant, IDCT, chroma upsample, color convert, resize)
+// now runs on device as a jitted kernel (ops/jpeg_device.py).
+//
+// Canonical page layout (the Python side sizes the grids to the batch max,
+// rounded to its chunk granularity):
+//   coef_y  : int16 [n, yb_h, yb_w, 64]   natural-order blocks, zero-padded
+//   coef_cb : int16 [n, cb_h, cb_w, 64]   (4:2:0 grid; zeros for grayscale)
+//   coef_cr : int16 [n, cb_h, cb_w, 64]
+//   quant   : int32 [n, 3, 64]            per-component dequant tables
+//   geom    : int32 [n, 6]                w, h, yb_w, yb_h, cb_w, cb_h (real,
+//                                         unpadded block counts)
+// Supported sources: baseline/progressive, 1-component grayscale and
+// 3-component with 2x2 luma sampling (the 4:2:0 every PIL/libjpeg default
+// writes). Anything else (4:4:4, 4:2:2, CMYK) is flagged in failed[] and
+// the Python driver re-encodes that row to 4:2:0 before retrying.
+
+namespace {
+
+// Probe one image: header-only parse. Returns 0 and fills
+// geom4 = {width, height, ncomp, coeff_ok} on success; nonzero on parse
+// failure (geom4 zeroed).
+int probe_one(const uint8_t* data, size_t len, int32_t* geom4) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    geom4[0] = geom4[1] = geom4[2] = geom4[3] = 0;
+    return 1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(data), (unsigned long)len);
+  jpeg_read_header(&cinfo, TRUE);
+  geom4[0] = (int32_t)cinfo.image_width;
+  geom4[1] = (int32_t)cinfo.image_height;
+  geom4[2] = (int32_t)cinfo.num_components;
+  int ok = 0;
+  if (cinfo.num_components == 1 &&
+      cinfo.jpeg_color_space == JCS_GRAYSCALE) {
+    ok = 1;
+  } else if (cinfo.num_components == 3 &&
+             cinfo.jpeg_color_space == JCS_YCbCr &&
+             cinfo.comp_info[0].h_samp_factor == 2 &&
+             cinfo.comp_info[0].v_samp_factor == 2 &&
+             cinfo.comp_info[1].h_samp_factor == 1 &&
+             cinfo.comp_info[1].v_samp_factor == 1 &&
+             cinfo.comp_info[2].h_samp_factor == 1 &&
+             cinfo.comp_info[2].v_samp_factor == 1) {
+    ok = 1;  // canonical 4:2:0
+  }
+  geom4[3] = ok;
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Extract one image's quantized coefficients into its canonical page slot.
+// Returns 0 on success, nonzero on failure (slot contents undefined; the
+// caller zero-fills pages up front).
+int extract_one(const uint8_t* data, size_t len, int yb_h, int yb_w, int cb_h,
+                int cb_w, int16_t* coef_y, int16_t* coef_cb, int16_t* coef_cr,
+                int32_t* quant, int32_t* geom6) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(data), (unsigned long)len);
+  jpeg_read_header(&cinfo, TRUE);
+  const int ncomp = cinfo.num_components;
+  const bool gray = ncomp == 1 && cinfo.jpeg_color_space == JCS_GRAYSCALE;
+  const bool ycc420 =
+      ncomp == 3 && cinfo.jpeg_color_space == JCS_YCbCr &&
+      cinfo.comp_info[0].h_samp_factor == 2 &&
+      cinfo.comp_info[0].v_samp_factor == 2 &&
+      cinfo.comp_info[1].h_samp_factor == 1 &&
+      cinfo.comp_info[1].v_samp_factor == 1 &&
+      cinfo.comp_info[2].h_samp_factor == 1 &&
+      cinfo.comp_info[2].v_samp_factor == 1;
+  if (!gray && !ycc420) {
+    jpeg_destroy_decompress(&cinfo);
+    return 2;
+  }
+  // The entropy decode: Huffman (or arithmetic) + DC prediction +
+  // de-zigzag into natural-order JBLOCKs. No IDCT, no upsample, no color.
+  jvirt_barray_ptr* arrays = jpeg_read_coefficients(&cinfo);
+  if (arrays == nullptr) {
+    jpeg_destroy_decompress(&cinfo);
+    return 3;
+  }
+  geom6[0] = (int32_t)cinfo.image_width;
+  geom6[1] = (int32_t)cinfo.image_height;
+  for (int ci = 0; ci < ncomp; ++ci) {
+    jpeg_component_info* comp = &cinfo.comp_info[ci];
+    const int bw = (int)comp->width_in_blocks;
+    const int bh = (int)comp->height_in_blocks;
+    const int grid_h = ci == 0 ? yb_h : cb_h;
+    const int grid_w = ci == 0 ? yb_w : cb_w;
+    if (bw > grid_w || bh > grid_h) {
+      jpeg_destroy_decompress(&cinfo);
+      return 4;  // caller's canonical grid too small (it probes first)
+    }
+    int16_t* page = ci == 0 ? coef_y : (ci == 1 ? coef_cb : coef_cr);
+    for (int row = 0; row < bh; ++row) {
+      JBLOCKARRAY rows = (cinfo.mem->access_virt_barray)(
+          (j_common_ptr)&cinfo, arrays[ci], (JDIMENSION)row, 1, FALSE);
+      int16_t* dst = page + ((size_t)row * grid_w) * 64;
+      static_assert(sizeof(JCOEF) == sizeof(int16_t),
+                    "JCOEF expected to be 16-bit");
+      std::memcpy(dst, rows[0][0], (size_t)bw * 64 * sizeof(int16_t));
+    }
+    if (ci == 0) {
+      geom6[2] = bw;
+      geom6[3] = bh;
+    } else if (ci == 1) {
+      geom6[4] = bw;
+      geom6[5] = bh;
+    }
+    JQUANT_TBL* qtbl = comp->quant_table != nullptr
+                           ? comp->quant_table
+                           : cinfo.quant_tbl_ptrs[comp->quant_tbl_no];
+    if (qtbl == nullptr) {
+      jpeg_destroy_decompress(&cinfo);
+      return 5;
+    }
+    for (int k = 0; k < 64; ++k) quant[ci * 64 + k] = (int32_t)qtbl->quantval[k];
+  }
+  if (gray) {
+    // Grayscale: zero chroma coefficients (pre-zeroed pages) decode to a
+    // flat 128 plane — neutral chroma, so RGB == Y on device. Report the
+    // canonical half-res chroma geometry and copy the luma quant table so
+    // the page is self-consistent.
+    geom6[4] = (geom6[2] + 1) / 2;
+    geom6[5] = (geom6[3] + 1) / 2;
+    for (int k = 0; k < 64; ++k) {
+      quant[1 * 64 + k] = quant[k];
+      quant[2 * 64 + k] = quant[k];
+    }
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Header-only probe of n JPEGs: geom[i*4..] = {w, h, ncomp, coeff_ok};
+// failed[i] = 1 on unparsable headers. Returns the failure count.
+int ldt_probe_batch(const uint8_t** srcs, const size_t* lens, int n,
+                    int32_t* geom, uint8_t* failed) {
+  int failures = 0;
+  for (int i = 0; i < n; ++i) {
+    int rc = probe_one(srcs[i], lens[i], geom + (size_t)i * 4);
+    if (failed) failed[i] = rc != 0 ? 1 : 0;
+    if (rc != 0) ++failures;
+  }
+  return failures;
+}
+
+// Entropy-decode n JPEGs into canonical coefficient pages (layout in the
+// header comment above; pages must be ZEROED by the caller — padding blocks
+// are never written). Returns the number of FAILED images; failed[i] is set
+// and that image's page contents are unspecified (still within bounds).
+int ldt_extract_coeffs(const uint8_t** srcs, const size_t* lens, int n,
+                       int yb_h, int yb_w, int cb_h, int cb_w,
+                       int16_t* coef_y, int16_t* coef_cb, int16_t* coef_cr,
+                       int32_t* quant, int32_t* geom, uint8_t* failed,
+                       int n_threads) {
+  if (n <= 0) return 0;
+  const size_t y_page = (size_t)yb_h * yb_w * 64;
+  const size_t c_page = (size_t)cb_h * cb_w * 64;
+  if (n_threads <= 0) n_threads = (int)std::thread::hardware_concurrency();
+  if (n_threads > n) n_threads = n;
+  std::atomic<int> next(0), failures(0);
+  auto worker = [&]() {
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
+      int rc = extract_one(srcs[i], lens[i], yb_h, yb_w, cb_h, cb_w,
+                           coef_y + (size_t)i * y_page,
+                           coef_cb + (size_t)i * c_page,
+                           coef_cr + (size_t)i * c_page, quant + (size_t)i * 192,
+                           geom + (size_t)i * 6);
+      if (rc != 0) {
+        if (failed) failed[i] = 1;
+        failures.fetch_add(1);
+      } else if (failed) {
+        failed[i] = 0;
+      }
+    }
+  };
+  if (n_threads <= 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(n_threads);
+    for (int t = 0; t < n_threads; ++t) pool.emplace_back(worker);
+    for (auto& th : pool) th.join();
+  }
+  return failures.load();
+}
+
 // Version tag so the Python side can detect stale builds.
-int ldt_decode_abi_version() { return 2; }
+int ldt_decode_abi_version() { return 3; }
 
 }  // extern "C"

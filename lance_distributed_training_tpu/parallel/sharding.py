@@ -42,6 +42,7 @@ __all__ = [
     "PIPELINE_RULES",
     "rules_for_task",
     "partition_specs",
+    "grad_partition_specs",
     "state_shardings",
     "batch_partition_spec",
 ]
@@ -162,7 +163,9 @@ def partition_specs(tree, rules: Sequence[Tuple[str, P]], mesh: Mesh, *,
                     fsdp_axis: Optional[str] = None,
                     fsdp_min_size: int = 16384,
                     zero_axis: Optional[str] = None,
-                    zero_paths: Sequence[str] = ("opt_state",)):
+                    zero_paths: Sequence[str] = ("opt_state",),
+                    zero_level: int = 1,
+                    grads_paths: Sequence[str] = ("acc_grads",)):
     """Pytree (arrays or ShapeDtypeStructs) → pytree of PartitionSpec.
 
     Every leaf's path is matched against ``rules`` (``re.search`` on the
@@ -191,8 +194,24 @@ def partition_specs(tree, rules: Sequence[Tuple[str, P]], mesh: Mesh, *,
     backward keep full replicas (no per-layer gathers, unlike FSDP).
     Composable with rule-sharded params: a rule-matched opt-state leaf
     keeps its rule spec (it already co-locates with its param shard).
+
+    ``zero_level`` extends that to ZeRO-2 (the second partition of the
+    same paper): level 1 shards only the true optimizer *moments* —
+    leaves under ``zero_paths`` whose path does NOT cross a ``grads_paths``
+    segment (``acc_grads``, the ``optax.MultiSteps`` gradient-accumulation
+    buffer) — while level 2 additionally shards the accumulation buffer,
+    so under ``grad_accum`` the persistent gradient state ALSO scales 1/N.
+    The in-flight reduce-scatter half of ZeRO-2 is the train step's
+    gradient sharding constraint (:func:`grad_partition_specs` +
+    ``make_train_step(grad_sharding=...)``); both halves are value-
+    preserving re-layouts, so the loss trajectory is bit-comparable to the
+    unsharded run (pinned by the slow parity test).
     """
     compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def _crosses(name: str, segments: Sequence[str]) -> bool:
+        parts = name.split("/")
+        return any(seg in parts for seg in segments)
 
     def assign(path, leaf):
         name = _path_str(path)
@@ -210,28 +229,56 @@ def partition_specs(tree, rules: Sequence[Tuple[str, P]], mesh: Mesh, *,
             if zero_axis is not None and any(
                 name == p or name.startswith(p + "/") for p in zero_paths
             ):
-                zs = _fsdp_spec(shape, mesh, zero_axis, fsdp_min_size)
-                if zs is not None:
-                    return zs
+                is_grads = _crosses(name, grads_paths)
+                if (not is_grads) or zero_level >= 2:
+                    zs = _fsdp_spec(shape, mesh, zero_axis, fsdp_min_size)
+                    if zs is not None:
+                        return zs
         return spec
 
     return jax.tree_util.tree_map_with_path(assign, tree)
 
 
+def grad_partition_specs(params_tree, mesh: Mesh, *, axis: str = "data",
+                         min_size: int = 16384):
+    """ZeRO-2's in-flight half: a PartitionSpec tree for the step's
+    *gradients* (same structure as the params), each leaf sharded on its
+    largest ``axis``-divisible dimension — the layout the accumulation
+    buffer and the optimizer moments already use under
+    ``zero_level >= 2``. Constraining the backward's gradients to it
+    (``jax.lax.with_sharding_constraint`` inside the jitted step) lets the
+    SPMD partitioner lower the gradient all-reduce to reduce-scatter +
+    shard-local update + param all-gather instead of materialising a full
+    replicated gradient per device. Small leaves stay replicated, matching
+    the state policy, so every gradient leaf lands exactly where its
+    moment/accumulator shard lives."""
+
+    def assign(leaf):
+        shape = getattr(leaf, "shape", ())
+        spec = _fsdp_spec(shape, mesh, axis, min_size)
+        return spec if spec is not None else P()
+
+    return jax.tree_util.tree_map(assign, params_tree)
+
+
 def state_shardings(abstract_state, mesh: Mesh, rules: Sequence[Tuple[str, P]],
                     *, fsdp_axis: Optional[str] = None,
                     fsdp_min_size: int = 16384,
-                    zero_axis: Optional[str] = None):
+                    zero_axis: Optional[str] = None,
+                    zero_level: int = 1):
     """NamedSharding tree for a whole TrainState.
 
     Works on ``jax.eval_shape`` output; because the optimizer's momentum/trace
     mirrors the param tree, the same path-tail rules shard it identically —
     params and their optimizer state are always co-located. With ``fsdp_axis``
     set, both are fully sharded over that axis; with ``zero_axis`` set, only
-    the ``opt_state`` subtree is (ZeRO-1 — see :func:`partition_specs`).
+    the ``opt_state`` subtree is — the moments at ``zero_level`` 1 (ZeRO-1),
+    plus the gradient-accumulation buffer at level 2 (ZeRO-2); see
+    :func:`partition_specs`.
     """
     specs = partition_specs(abstract_state, rules, mesh, fsdp_axis=fsdp_axis,
-                            fsdp_min_size=fsdp_min_size, zero_axis=zero_axis)
+                            fsdp_min_size=fsdp_min_size, zero_axis=zero_axis,
+                            zero_level=zero_level)
     return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs)
 
 

@@ -76,6 +76,7 @@ class RemoteLoader:
         timeout_s: float = 120.0,
         task_type: Optional[str] = None,
         image_size: Optional[int] = None,
+        device_decode: Optional[bool] = None,
         registry: Optional[MetricsRegistry] = None,
         buffer_pool=None,
     ):
@@ -99,6 +100,7 @@ class RemoteLoader:
         # time (silent wrong-resolution training is the alternative).
         self.task_type = task_type
         self.image_size = image_size
+        self.device_decode = device_decode
         self.registry = registry if registry is not None else default_registry()
         self.counters = ServiceCounters(registry=self.registry)
         # Buffer plane: received tensors are copied into recycled pool
@@ -206,6 +208,7 @@ class RemoteLoader:
             version=self._hello_version,
             task_type=self.task_type,
             image_size=self.image_size,
+            device_decode=self.device_decode,
         )
 
     def _connect(self, start_step: int, probe: bool = False,

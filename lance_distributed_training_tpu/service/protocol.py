@@ -516,6 +516,7 @@ def hello(
     probe: bool = False,
     task_type: Optional[str] = None,
     image_size: Optional[int] = None,
+    device_decode: Optional[bool] = None,
     version: int = PROTOCOL_VERSION,
 ) -> dict:
     """Build the HELLO payload — the client's shard-of-the-plan request.
@@ -557,4 +558,10 @@ def hello(
         "probe": bool(probe),
         "task_type": task_type,
         "image_size": int(image_size) if image_size is not None else None,
+        # None = undeclared (old callers): the server skips the check, as
+        # with task_type/image_size. Declared, it must match the server's
+        # pixel-vs-coefficient-page serving mode.
+        "device_decode": (
+            bool(device_decode) if device_decode is not None else None
+        ),
     }

@@ -76,6 +76,12 @@ class ServeConfig:
     buffer_pool: bool = True  # recycle decode/copy-out pages through the
     # process BufferPool (bufpool_* metrics show hit/miss on /metrics);
     # False = fault a fresh allocation per batch (the pre-r6 behavior)
+    device_decode: bool = False  # serve half-decoded JPEG coefficient
+    # pages (data/device_decode.py) instead of finished pixels: this host
+    # does only the entropy half of decode and clients run the dense back
+    # half as their jitted device kernel (ops/jpeg_device.py). Both sides
+    # must agree — the HELLO's device_decode field is skew-checked like
+    # task_type/image_size. Classification only.
     queue_depth: int = 4  # per-client bounded batch queue
     handshake_timeout_s: float = 30.0  # HELLO recv deadline per connection
     read_retries: int = 3  # dataset-read attempts before ERROR
@@ -463,7 +469,8 @@ class DataService:
         # The SAME dispatch the trainer uses — the bit-identical-batches
         # guarantee depends on both sides binding one decoder implementation.
         self.decode_fn = decoder_for_task(
-            config.task_type, config.image_size, buffer_pool=self.buffer_pool
+            config.task_type, config.image_size, buffer_pool=self.buffer_pool,
+            device_decode=config.device_decode,
         )
         self.counters = ServiceCounters()
         self.workers = None
@@ -583,6 +590,16 @@ class DataService:
             return (
                 f"decode-config skew: server decodes image_size="
                 f"{cfg.image_size}, client expects {size}"
+            )
+        dd = req.get("device_decode")
+        if dd is not None and bool(dd) != bool(cfg.device_decode):
+            # A pixel client fed coefficient pages has no kernel to finish
+            # them; a coefficient client fed pixels silently trains on a
+            # differently-decoded stream. Reject, like the knobs above.
+            return (
+                "decode-config skew: server serves "
+                f"device_decode={bool(cfg.device_decode)}, client expects "
+                f"{bool(dd)}"
             )
         return None
 

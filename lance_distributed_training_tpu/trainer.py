@@ -120,6 +120,17 @@ class TrainConfig:
     # the process BufferPool: decode writes into warm leased pages and the
     # loader returns them after device_put dispatch (bufpool_* metrics on
     # /metrics). False = fault a fresh allocation per batch (pre-r6).
+    device_decode: bool = False  # split the JPEG hot loop at the entropy
+    # boundary: the host does only the sequential Huffman/entropy decode
+    # and ships half-decoded coefficient pages (data/device_decode.py)
+    # through the placement ring; dequant + 8x8 IDCT + chroma upsample +
+    # YCbCr->RGB + resize run as a pure jitted device kernel
+    # (ops/jpeg_device.py, integer-exact, bit-deterministic) applied as a
+    # timed transform stage ahead of the train step, where XLA overlaps it
+    # with the step like any other device work. Classification only;
+    # degrades to the host pixel path (with one warning) when the native
+    # coefficient extractor is unavailable. False (--no_device_decode) =
+    # the exact r11 host decode path, the A/B control arm.
     data_service_addr: Optional[str] = None  # host:port of a running
     # `ldt serve-data` DataService: decode runs on that host's fleet and this
     # process streams plan-ordered device-ready batches (RemoteLoader) —
@@ -216,12 +227,19 @@ class TrainConfig:
     pp_microbatches: int = 4  # microbatches per pipeline round
     fsdp: bool = False  # ZeRO-3-style: fully shard params + optimizer state
     # over the 'data' axis; XLA inserts the per-layer all-gathers
-    zero_opt: bool = False  # ZeRO-1-style: shard ONLY the optimizer state
-    # over the 'data' axis (params stay replicated) — the SPMD partitioner
-    # reduce-scatters gradients into each replica's opt-state shard and
-    # all-gathers just the updated params, so optimizer memory scales 1/N
-    # with the mesh at no per-layer forward/backward gathers. Mutually
-    # exclusive with fsdp (which already shards the optimizer state).
+    zero_opt: int = 0  # ZeRO gradient/optimizer sharding over the 'data'
+    # axis, params replicated. 1 (or legacy True): shard the optimizer
+    # MOMENTS only — the SPMD partitioner reduce-scatters gradients into
+    # each replica's opt-state shard and all-gathers just the updated
+    # params, so optimizer memory scales 1/N with the mesh at no per-layer
+    # forward/backward gathers. 2: ZeRO-2 — additionally shard the
+    # gradient-accumulation buffer (optax.MultiSteps acc_grads, the
+    # persistent gradient state under --grad_accum) and constrain the
+    # step's gradients to the same layout (parallel/sharding.py
+    # grad_partition_specs), so the backward's gradient never materialises
+    # fully replicated. Value-preserving re-layouts both — the loss
+    # trajectory matches the unsharded run (pinned by a slow parity
+    # test). Mutually exclusive with fsdp (which already shards both).
     # -- aux subsystems the reference lacks (SURVEY.md §5) --
     checkpoint_dir: Optional[str] = None  # orbax save/restore root
     checkpoint_every: int = 1  # save every N epochs
@@ -373,7 +391,7 @@ def create_train_state(rng: jax.Array, task: Task, config: TrainConfig,
 def create_sharded_train_state(
     rng: jax.Array, task: Task, config: TrainConfig, mesh, rules=(),
     *, fsdp_axis: Optional[str] = None, zero_axis: Optional[str] = None,
-    total_steps: Optional[int] = None,
+    zero_level: int = 1, total_steps: Optional[int] = None,
 ):
     """Initialize the TrainState *directly sharded* over the mesh.
 
@@ -404,7 +422,7 @@ def create_sharded_train_state(
 
     abstract = jax.eval_shape(_create, rng)
     shardings = state_shardings(abstract, mesh, rules, fsdp_axis=fsdp_axis,
-                                zero_axis=zero_axis)
+                                zero_axis=zero_axis, zero_level=zero_level)
     return jax.jit(_create, out_shardings=shardings)(rng), shardings
 
 
@@ -417,7 +435,7 @@ def _variables(state: TrainState) -> dict:
 
 def make_train_step(task: Task, mesh, *, donate: bool = True,
                     state_sharding=None, batch_spec=None,
-                    grad_norm: bool = False):
+                    grad_norm: bool = False, grad_sharding=None):
     """Build the jitted sharded train step.
 
     Pure DP (the reference's scope): state replicated (``P()``), every batch
@@ -443,6 +461,14 @@ def make_train_step(task: Task, mesh, *, donate: bool = True,
         (loss, new_model_state), grads = jax.value_and_grad(
             loss_of, has_aux=True
         )(state.params)
+        if grad_sharding is not None:
+            # ZeRO-2's in-flight half: pin the gradients to the moment/
+            # accumulator layout (grad_partition_specs), so the SPMD
+            # partitioner lowers the data-axis gradient mean to
+            # reduce-scatter + shard-local optimizer update + param
+            # all-gather instead of a full all-reduce per device. A pure
+            # re-layout — gradient VALUES are unchanged.
+            grads = jax.lax.with_sharding_constraint(grads, grad_sharding)
         state = state.apply_gradients(grads=grads)
         if new_model_state is not None and "batch_stats" in new_model_state:
             state = state.replace(batch_stats=new_model_state["batch_stats"])
@@ -562,7 +588,8 @@ def _decoder_for(config: TrainConfig):
     from .data.decode import decoder_for_task
 
     return decoder_for_task(config.task_type, config.image_size,
-                            buffer_pool=_loader_buffer_pool(config))
+                            buffer_pool=_loader_buffer_pool(config),
+                            device_decode=config.device_decode)
 
 
 def _make_worker_pool(config: TrainConfig, dataset):
@@ -649,6 +676,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             columns=getattr(decode, "required_columns", None),
             task_type=config.task_type,
             image_size=config.image_size,
+            device_decode=config.device_decode,
             buffer_pool=_loader_buffer_pool(config),
         )
         if config.coordinator_addr:
@@ -996,6 +1024,39 @@ def train(config: TrainConfig) -> dict:
             "fsdp and zero_opt are mutually exclusive: fsdp (ZeRO-3) "
             "already shards the optimizer state along with the params"
         )
+    if int(config.zero_opt) not in (0, 1, 2):
+        raise ValueError(
+            f"zero_opt must be 0, 1 (shard optimizer state) or 2 (also "
+            f"shard gradient accumulation), got {config.zero_opt!r}"
+        )
+    if config.device_decode and config.task_type != "classification":
+        raise ValueError(
+            "device_decode splits the JPEG decode loop and currently "
+            f"supports task_type='classification' only, got "
+            f"{config.task_type!r}"
+        )
+    if (
+        config.device_decode
+        and (config.num_processes or 1) > 1
+        and not (config.data_service_addr or config.coordinator_addr)
+    ):
+        import warnings
+
+        # Known limit: each host's CoeffImageDecoder grows its canonical
+        # page grid independently (to ITS shard's largest image), and
+        # global-batch assembly needs identical non-batch dims on every
+        # process — shards with different max image sizes would crash
+        # mid-epoch. Uniform-size corpora are fine; mixed-size multi-host
+        # local decode is not yet.
+        warnings.warn(
+            "device_decode with multi-process LOCAL decode requires every "
+            "process's shard to share the same maximum image size (the "
+            "canonical coefficient grid must agree across hosts for "
+            "global-batch assembly); mixed-size corpora should stream "
+            "pixels (--no_device_decode) or move decode behind one data "
+            "service until per-dataset grid pinning lands",
+            stacklevel=2,
+        )
     if config.placement_depth < 1:
         raise ValueError(
             f"placement_depth must be >= 1, got {config.placement_depth}"
@@ -1122,6 +1183,7 @@ def train(config: TrainConfig) -> dict:
         init_rng, task, config, mesh, rules,
         fsdp_axis="data" if config.fsdp else None,
         zero_axis="data" if config.zero_opt else None,
+        zero_level=int(config.zero_opt) or 1,
         total_steps=total_steps,
     )
     if config.pretrained:
@@ -1155,9 +1217,19 @@ def train(config: TrainConfig) -> dict:
         else None
     )
 
+    grad_sharding = None
+    if int(config.zero_opt) >= 2:
+        from jax.sharding import NamedSharding
+
+        from .parallel.sharding import grad_partition_specs
+
+        grad_sharding = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s),
+            grad_partition_specs(state.params, mesh),
+        )
     train_step = make_train_step(
         task, mesh, state_sharding=state_sharding, batch_spec=batch_spec,
-        grad_norm=config.log_grad_norm,
+        grad_norm=config.log_grad_norm, grad_sharding=grad_sharding,
     )
     eval_step = make_eval_step(
         task, mesh, state_sharding=state_sharding, batch_spec=batch_spec
@@ -1347,6 +1419,37 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 chaos=None, trace=None, journal=None, tuner=None):
     if journal is None:
         journal = _CkptJournal(resume_global_step)
+    # Device-decode transform stage (--device_decode): one jitted kernel
+    # call replacing a batch's coefficient pages with the decoded image —
+    # device work dispatched from the consumer thread, so it overlaps the
+    # previous step's compute exactly like the H2D ring does. Timed into
+    # trainer_transform_ms (dispatch time; the device cost itself lands
+    # inside the step's execution window on async backends). Pixel batches
+    # (the --no_device_decode arm or the degraded PIL path) pass through,
+    # so one handle covers both arms. Applied BEFORE the device_cache
+    # fill: the cache then holds finished image batches, decoding each
+    # coefficient page exactly once per run.
+    transform = None
+    transform_hist = None
+    device_ms_hist = None
+    if config.device_decode:
+        from .obs.registry import default_registry
+        from .ops.jpeg_device import make_batch_transform
+
+        transform = make_batch_transform(config.image_size)
+        transform_hist = default_registry().histogram("trainer_transform_ms")
+        # decode_device_ms: the kernel's REAL device cost, sampled — every
+        # 16th batch the transform is awaited to completion and timed (one
+        # sync per 16 steps; the other 15 stay fully async). This is what
+        # feeds the autotuner's decode_split attribution and the /metrics
+        # series the CI smoke scrapes.
+        device_ms_hist = default_registry().histogram("decode_device_ms")
+        _eval_raw = eval_step
+
+        def eval_step(state, batch, _inner=_eval_raw, _tx=transform):
+            # Eval loaders share the decoder, so their batches carry
+            # coefficient pages too (plus _weight, which passes through).
+            return _inner(state, _tx(batch))
     # HBM-resident dataset cache (--device_cache): filled on the first
     # executed epoch, replayed afterwards. See TrainConfig.device_cache.
     cache: list = []
@@ -1427,6 +1530,34 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             timer.loader_stop()
             if batch is None:
                 break
+            if transform is not None:
+                # Coefficient pages → image, on device (dispatch-timed;
+                # async backends execute it inside the step window).
+                sample = epoch_batches % 16 == 0
+                raw = batch
+                t0 = time.monotonic_ns()
+                with obs_span("train.transform", step=global_step):
+                    batch = transform(raw)
+                    decoded = batch is not raw
+                    if sample and decoded:
+                        # Await the sampled kernel run so decode_device_ms
+                        # records execution, not dispatch — via a scalar
+                        # VALUE fetch, not block_until_ready (the tunneled
+                        # TPU backend returns from block_until_ready before
+                        # execution completes; fetching any element forces
+                        # the producing kernel to finish). Degraded pixel
+                        # batches pass through `raw` unchanged and are
+                        # never sampled.
+                        _ = int(batch["image"][0, 0, 0, 0])
+                dt_ms = (time.monotonic_ns() - t0) / 1e6
+                transform_hist.observe(dt_ms)
+                if sample and decoded:
+                    if global_step > 0:
+                        # Skip the run's first sample: it pays the kernel's
+                        # XLA compile, which would dominate the histogram's
+                        # p50 and skew the autotuner's decode_split toward
+                        # device_transform_bound on cold starts.
+                        device_ms_hist.observe(dt_ms)
             epoch_batches += 1
             if filling:
                 if not cache:

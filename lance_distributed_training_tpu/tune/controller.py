@@ -86,6 +86,23 @@ def derive_window(delta: Dict[str, float]) -> Dict[str, float]:
         if p95 is not None:
             w["decode_ms_p95"] = p95
             break
+    # Device-decode split attribution (the --device_decode arm): the host
+    # entropy half's share of the per-batch decode cost. Near 1.0 = the
+    # host Huffman pass dominates (more decode workers still pay off);
+    # near 0.0 = the jitted device kernel dominates (growing the worker
+    # pool buys nothing — the policy skips that rung). Present only when
+    # both series saw traffic this window — which means IN-PROCESS decode:
+    # registries are process-local, so with a WorkerPool (num_workers>0)
+    # or a remote data service the entropy histogram lands in the decoding
+    # process and the signal is absent here; the policy then falls back to
+    # the plain capacity ladder (cross-process metric forwarding is the
+    # open item, same locality as the server-side svc_* series).
+    entropy_p50 = delta.get("decode_entropy_ms_p50")
+    device_p50 = delta.get("decode_device_ms_p50")
+    if entropy_p50 is not None and device_p50 is not None:
+        total = entropy_p50 + device_p50
+        if total > 0:
+            w["decode_split"] = entropy_p50 / total
     queue_wait = delta.get("svc_queue_wait_ms_p95")
     if queue_wait is not None:
         w["queue_wait_ms_p95"] = queue_wait

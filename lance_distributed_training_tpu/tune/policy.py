@@ -52,6 +52,10 @@ BOTTLENECK_CODES = {
     "h2d_bound": 3,
     "pool_bound": 4,
     "train_bound": 5,
+    # --device_decode runs: stalled while the device transform (not the
+    # host entropy half) dominates per-batch decode — more decode workers
+    # cannot help, the ladder skips that rung.
+    "device_transform_bound": 6,
 }
 
 # Capacity ladder for decode/transport-bound growth, in expected-payoff
@@ -93,6 +97,11 @@ class PolicyConfig:
     # transient stall spike in its first window; one clean window clears
     # the verdict (reacting to the transient is the classic oscillation)
     blocked_ticks: int = 8  # windows a reverted knob stays off-limits
+    decode_split_lo: float = 0.35  # --device_decode attribution: when the
+    # host entropy share of decode falls below this, the bottleneck is the
+    # device kernel, not host decode — the capacity ladder skips the
+    # workers rung (spawning decode processes cannot move a device-bound
+    # stall; the prefetch/stripe rungs still apply)
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -219,10 +228,20 @@ class HillClimbPolicy:
                           bounds["bufpool_pages"][1]),
                     "pool_bound", stall, knobs,
                 )
+            device_bound = (
+                window.get("decode_split", 1.0) < c.decode_split_lo
+            )
             for knob in _GROW_LADDER:
+                if knob == "workers" and device_bound:
+                    # decode_split attribution: the device kernel, not the
+                    # host entropy half, owns the decode cost — a bigger
+                    # worker pool cannot move this stall. Skip to the
+                    # transport rungs.
+                    continue
                 if self._growable(knob, knobs, bounds):
                     reason = (
                         "decode_bound" if knob == "workers"
+                        else "device_transform_bound" if device_bound
                         else "transport_bound"
                     )
                     self.last_bottleneck = reason
@@ -233,7 +252,9 @@ class HillClimbPolicy:
             # Stalled with every knob at its ceiling (or blocked): nothing
             # left to actuate — the fleet half's scale-up recommendation is
             # the next lever (Coordinator pressure aggregation).
-            self.last_bottleneck = "decode_bound"
+            self.last_bottleneck = (
+                "device_transform_bound" if device_bound else "decode_bound"
+            )
             return []
         if stall <= c.stall_lo_pct:
             self._calm += 1

@@ -35,6 +35,13 @@
 # autotune_decisions_total must be > 0 on a live /metrics scrape, the
 # consumed stream must stay bit-identical to a fixed-knob control pass,
 # and the LDT_AUTOTUNE_TRACE decision trace must replay deterministically.
+# Stage 7b — device-decode smoke (scripts/device_decode_smoke.py): the
+# JPEG entropy split on forced-CPU devices — host-vs-device parity within
+# the pinned envelope with bit-identical device-arm repeats, a live
+# /metrics scrape of the decode_entropy_ms / decode_device_ms /
+# trainer_transform_ms / decode_*_bytes_total series during a real
+# --device_decode train run, and zero BufferPool-lease or /dev/shm leaks
+# under LDT_LEAK_SANITIZER=1.
 # Stage 8 — the tier-1 verify command from ROADMAP.md, verbatim — run
 # under LDT_LOCK_SANITIZER=1 AND LDT_LEAK_SANITIZER=1: every
 # threading.Lock/RLock the package creates is wrapped to record actual
@@ -144,6 +151,12 @@ echo "== autotune smoke (closed-loop controller on live /metrics) =="
 # decisions on a live scrape, keep the stream bit-identical, and leave a
 # deterministically-replayable decision trace.
 timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/autotune_smoke.py
+
+echo "== device-decode smoke (entropy split, parity + live decode_* scrape) =="
+# Forced-CPU devices; the same jitted kernel path runs unmodified on real
+# TPU (no host callbacks — LDT101/LDT1301 pin it). Leak sanitizer on: the
+# stage fails on any stranded BufferPool lease or /dev/shm segment.
+timeout -k 10 480 env JAX_PLATFORMS=cpu LDT_LEAK_SANITIZER=1 PYTHONPATH=. python scripts/device_decode_smoke.py
 
 echo "== tier-1 tests (lock + leak sanitizers on) =="
 WITNESS=/tmp/_ldt_lock_witness.json

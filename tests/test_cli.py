@@ -108,3 +108,35 @@ def test_top_level_api_exports():
 
     assert callable(ldt.train)
     assert ldt.TrainConfig(dataset_path="/d").batch_size == 512
+
+
+def test_cli_zero_levels_and_device_decode(monkeypatch):
+    captured = {}
+    monkeypatch.setattr(
+        cli, "train", lambda config: captured.update(config=config) or {}
+    )
+    cli.main(["--dataset_path", "/d", "--no_wandb"])
+    assert captured["config"].zero_opt == 0
+    assert captured["config"].device_decode is False
+    cli.main(["--dataset_path", "/d", "--no_wandb", "--zero"])
+    assert captured["config"].zero_opt == 1  # bare flag = ZeRO-1 (legacy)
+    cli.main(["--dataset_path", "/d", "--no_wandb", "--zero", "2",
+              "--device_decode"])
+    assert captured["config"].zero_opt == 2
+    assert captured["config"].device_decode is True
+    cli.main(["--dataset_path", "/d", "--no_wandb", "--no_device_decode"])
+    assert captured["config"].device_decode is False
+    # --device_decode and --no_device_decode are mutually exclusive.
+    with pytest.raises(SystemExit):
+        cli.main(["--dataset_path", "/d", "--device_decode",
+                  "--no_device_decode"])
+
+
+def test_serve_parser_device_decode():
+    args = cli.build_serve_parser().parse_args(
+        ["--dataset_path", "/d", "--device_decode"]
+    )
+    assert args.device_decode is True
+    assert cli.build_serve_parser().parse_args(
+        ["--dataset_path", "/d"]
+    ).device_decode is False

@@ -279,6 +279,83 @@ def test_zero_axis_shards_only_opt_state():
     assert shardings.opt_state[0].trace["dense"]["bias"].spec == P()
 
 
+def test_zero2_shards_gradient_accumulation():
+    """ZeRO-2's persistent half: level 1 leaves the MultiSteps acc_grads
+    buffer replicated (moments only), level 2 shards it too."""
+    import optax
+    from flax.training import train_state
+
+    from lance_distributed_training_tpu.parallel.sharding import (
+        state_shardings,
+    )
+
+    class TS(train_state.TrainState):
+        batch_stats: object = None
+
+    params = {"dense": {"kernel": np.zeros((256, 256), np.float32),
+                        "bias": np.zeros((256,), np.float32)}}
+    state = TS.create(
+        apply_fn=None, params=params, batch_stats=None,
+        tx=optax.MultiSteps(optax.sgd(0.1, momentum=0.9),
+                            every_k_schedule=2),
+    )
+    mesh = get_mesh()
+    abstract = jax.eval_shape(lambda: state)
+    lvl1 = state_shardings(abstract, mesh, (), zero_axis="data",
+                           zero_level=1)
+    lvl2 = state_shardings(abstract, mesh, (), zero_axis="data",
+                           zero_level=2)
+    acc1 = lvl1.opt_state.acc_grads["dense"]["kernel"]
+    acc2 = lvl2.opt_state.acc_grads["dense"]["kernel"]
+    assert acc1.spec == P()           # ZeRO-1: grads buffer replicated
+    assert acc2.spec == P("data")     # ZeRO-2: grads buffer sharded
+    # Moments shard at BOTH levels; params replicated at both.
+    assert lvl1.opt_state.inner_opt_state[0].trace["dense"]["kernel"].spec \
+        == P("data")
+    assert lvl2.params["dense"]["kernel"].spec == P()
+    # Small leaves (bias, step counters) stay replicated everywhere.
+    assert lvl2.opt_state.acc_grads["dense"]["bias"].spec == P()
+
+
+def test_grad_partition_specs_mirror_state_policy():
+    from lance_distributed_training_tpu.parallel.sharding import (
+        grad_partition_specs,
+    )
+
+    mesh = get_mesh()
+    params = {"dense": {"kernel": np.zeros((256, 256), np.float32),
+                        "bias": np.zeros((256,), np.float32)}}
+    specs = grad_partition_specs(params, mesh)
+    assert specs["dense"]["kernel"] == P("data")
+    assert specs["dense"]["bias"] == P()  # small leaf: replicated
+
+
+@pytest.mark.slow
+def test_zero2_trains_like_replicated(image_dataset, tmp_path):
+    """The pinned ZeRO-2 parity run: gradient-accumulation sharding plus
+    the in-step reduce-scatter constraint are pure re-layouts — the loss
+    after N accumulated steps must match the unsharded run."""
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    common = dict(
+        dataset_path=image_dataset.uri, num_classes=10, image_size=32,
+        batch_size=16, epochs=1, max_steps=4, no_wandb=True,
+        eval_at_end=False, log_every=0, model_name="resnet18",
+        optimizer="adamw", lr=0.001, grad_accum=2,
+    )
+    base = train(TrainConfig(**common))
+    zero2 = train(TrainConfig(**common, zero_opt=2))
+    assert zero2["loss"] == pytest.approx(base["loss"], rel=1e-5)
+
+
+def test_zero_level_validation(tmp_path):
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    with pytest.raises(ValueError, match="zero_opt must be"):
+        train(TrainConfig(dataset_path=str(tmp_path / "missing"),
+                          zero_opt=3))
+
+
 @pytest.mark.slow
 def test_zero_opt_trains_like_replicated(image_dataset, tmp_path):
     from lance_distributed_training_tpu.trainer import TrainConfig, train

@@ -679,3 +679,61 @@ def test_data_service_pressure_window(tmp_path):
     assert p["active_clients"] == 1
     assert p["stall_pct"] == 100.0  # clamped: starved the whole window
     svc._sessions.clear()
+
+
+# -- device-decode split attribution (r12) -----------------------------------
+
+
+def test_derive_window_decode_split():
+    w = derive_window({
+        "trainer_step_ms_count": 10.0,
+        "trainer_loader_ms_sum": 100.0, "trainer_step_ms_sum": 100.0,
+        "decode_entropy_ms_p50": 30.0, "decode_device_ms_p50": 10.0,
+    })
+    assert w["decode_split"] == pytest.approx(0.75)
+    # Either series absent (host-decode runs): no signal key at all.
+    assert "decode_split" not in derive_window({
+        "trainer_step_ms_count": 10.0,
+        "trainer_loader_ms_sum": 100.0, "trainer_step_ms_sum": 100.0,
+        "decode_entropy_ms_p50": 30.0,
+    })
+
+
+def test_policy_device_bound_skips_workers_rung():
+    """decode_split below the threshold = the jitted kernel, not host
+    entropy decode, owns the cost — growing the worker pool is pointless;
+    the ladder moves to the next rung and labels the bottleneck."""
+    p = HillClimbPolicy(PolicyConfig(min_steps=1))
+    out = p.decide(
+        stalled(decode_split=0.1),
+        _knobs(workers=1, prefetch=2), BOUNDS,
+    )
+    assert [(d.knob, d.reason) for d in out] == [
+        ("prefetch", "device_transform_bound")
+    ]
+    assert p.last_bottleneck == "device_transform_bound"
+
+
+def test_policy_entropy_bound_still_grows_workers():
+    p = HillClimbPolicy(PolicyConfig(min_steps=1))
+    out = p.decide(
+        stalled(decode_split=0.9),
+        _knobs(workers=1, prefetch=2), BOUNDS,
+    )
+    assert [(d.knob, d.reason) for d in out] == [("workers", "decode_bound")]
+
+
+def test_policy_device_bound_with_every_rung_capped():
+    p = HillClimbPolicy(PolicyConfig(min_steps=1))
+    out = p.decide(
+        stalled(decode_split=0.1),
+        _knobs(workers=1, prefetch=16, stripe_width=32), BOUNDS,
+    )
+    assert out == []
+    assert p.last_bottleneck == "device_transform_bound"
+
+
+def test_bottleneck_code_registered_for_device_transform():
+    from lance_distributed_training_tpu.tune.policy import BOTTLENECK_CODES
+
+    assert BOTTLENECK_CODES["device_transform_bound"] == 6
