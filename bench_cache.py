@@ -1,0 +1,242 @@
+"""Epoch-coherent batch-cache A/B — the r13 acceptance benchmark
+(BENCH_CACHE_r10).
+
+Two arms over one shared synthetic columnar corpus, INTERLEAVED pass by
+pass in one process (the BENCH_ZC_r06 / BENCH_H2D_r07 /
+BENCH_DEVICE_DECODE_r09 discipline: this box's run-to-run throughput
+drift cancels out of the within-pair comparison):
+
+* ``nocache`` — the ``--no_batch_cache`` arm: the exact r12 pipeline,
+  every epoch re-reads fragments and re-runs the native JPEG decode;
+* ``cache`` — the same pipeline with a :class:`BatchCache` bound at the
+  decode boundary. Pass 0 is the COLD (fill) epoch — recorded separately,
+  it pays decode plus the copy-in/spill tax; every later pass is a WARM
+  epoch streaming hits (RAM ring first, sha256-verified disk segments for
+  the spilled remainder — the RAM budget is deliberately set below the
+  decoded corpus size so the bench exercises BOTH tiers).
+
+Both arms feed the same near-free jitted consumer step, so loader-stall%%
+means the same thing in both: the share of the pass the consumer spent
+waiting on the producer side. Per-step digests are recorded on EVERY
+pass of EVERY arm and must be bit-identical — the cache is a capacity
+move, never a content move.
+
+Honest-bench notes: CPU basis — decode and the (tiny) step share this
+box's cores, and the warm arm's remaining cost is a memcpy out of cache
+pages (plus a disk read + hash verify for spilled entries). On a real
+deployment the same warm path frees the decode cores entirely for other
+tenants, which is the tf.data-service argument this plane implements;
+the stall-cut is the basis-independent signal.
+
+Acceptance (ISSUE 13): warm cache arm cuts loader stall by >= 20 points
+vs the no-cache arm; per-step digests bit-identical across both arms and
+across cold/warm epochs.
+
+Usage::
+
+    python bench_cache.py                    # full run
+    BENCH_SMALL=1 python bench_cache.py      # tiny smoke
+    BENCH_CACHE_ROWS=4096 BENCH_CACHE_PASSES=5 python bench_cache.py
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+SMALL = bool(os.environ.get("BENCH_SMALL"))
+ROWS = int(os.environ.get("BENCH_CACHE_ROWS") or 0) or (256 if SMALL else 2048)
+# warm passes measured; +1 cold fill pass up front
+PASSES = int(os.environ.get("BENCH_CACHE_PASSES") or 0) or (2 if SMALL else 3)
+BATCH = 16 if SMALL else 64
+SRC_SIZE = 96 if SMALL else 256
+OUT_SIZE = 64 if SMALL else 224
+PRODUCERS = 2
+# RAM ring sized to roughly a third of the decoded corpus, so warm passes
+# measurably exercise the disk tier too (spill + sha256-verify + promote).
+RAM_MB = 2 if SMALL else 8
+OUT_PATH = os.environ.get("BENCH_CACHE_OUT") or "BENCH_CACHE_r10.json"
+
+
+def main() -> None:
+    from _bench_init import force_cpu
+
+    force_cpu(1)
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from lance_distributed_training_tpu.data.authoring import (
+        create_synthetic_classification_dataset,
+    )
+    from lance_distributed_training_tpu.data.buffers import BufferPool
+    from lance_distributed_training_tpu.data.cache import BatchCache
+    from lance_distributed_training_tpu.data.decode import (
+        ImageClassificationDecoder,
+    )
+    from lance_distributed_training_tpu.data.pipeline import (
+        make_train_pipeline,
+    )
+    from lance_distributed_training_tpu.obs.registry import MetricsRegistry
+
+    tmp = tempfile.mkdtemp(prefix="ldt-bench-cache-")
+    ds = create_synthetic_classification_dataset(
+        os.path.join(tmp, "ds"), rows=ROWS, num_classes=10,
+        image_size=SRC_SIZE, fragment_size=max(ROWS // 4, 64),
+        unique_images=64, seed=11,
+    )
+
+    # Near-free jitted consumer (the bench_device_decode basis): the
+    # question is what the producer side costs, not how fast a model
+    # trains — a heavy step would mask the stall signal on this box.
+    @jax.jit
+    def step(images_u8):
+        return jnp.sum(images_u8[:, ::32, ::32, :], dtype=jnp.int32)
+
+    pool = BufferPool(registry=MetricsRegistry())
+    decode = ImageClassificationDecoder(image_size=OUT_SIZE,
+                                        buffer_pool=pool)
+    cache_reg = MetricsRegistry()
+    cache = BatchCache(
+        cache_dir=os.path.join(tmp, "cache"),
+        ram_budget_mb=RAM_MB, disk_budget_mb=4096,
+        buffer_pool=pool, registry=cache_reg,
+    )
+
+    def make_loader(cached: bool):
+        return make_train_pipeline(
+            ds, "batch", BATCH, 0, 1, decode, producers=PRODUCERS,
+            buffer_pool=pool, batch_cache=cache if cached else None,
+        )
+
+    def run_pass(cached: bool):
+        """One full epoch: (wall_s, stall_s, steps, digests)."""
+        digests = []
+        stall = 0.0
+        steps = 0
+        it = iter(make_loader(cached))
+        t_pass = time.perf_counter()
+        while True:
+            t0 = time.perf_counter()
+            batch = next(it, None)
+            stall += time.perf_counter() - t0
+            if batch is None:
+                break
+            loss = step(batch["image"])
+            jax.block_until_ready(loss)
+            digests.append(hashlib.sha256(
+                np.ascontiguousarray(batch["image"])
+            ).hexdigest())
+            steps += 1
+        wall = time.perf_counter() - t_pass
+        return wall, stall, steps, digests
+
+    # Warm the jit cache outside the timing.
+    warmup = next(iter(make_loader(False)), None)
+    jax.block_until_ready(step(warmup["image"]))
+
+    def record_pass(acc, wall, stall, steps):
+        acc["wall"] += wall
+        acc["stall"] += stall
+        acc["steps"] += steps
+
+    arms = {name: dict(wall=0.0, stall=0.0, steps=0)
+            for name in ("nocache", "cache_cold", "cache_warm")}
+    digest_sets = []
+
+    # Pass 0: no-cache pass + the cache arm's COLD (fill) epoch.
+    wall, stall, steps, d = run_pass(False)
+    record_pass(arms["nocache"], wall, stall, steps)
+    digest_sets.append(d)
+    print(json.dumps({"pass": 0, "arm": "nocache",
+                      "wall_s": round(wall, 3),
+                      "stall_s": round(stall, 3)}), flush=True)
+    wall, stall, steps, d = run_pass(True)
+    record_pass(arms["cache_cold"], wall, stall, steps)
+    digest_sets.append(d)
+    print(json.dumps({"pass": 0, "arm": "cache_cold",
+                      "wall_s": round(wall, 3),
+                      "stall_s": round(stall, 3)}), flush=True)
+
+    # Interleaved warm pairs: nocache vs cache-warm, pass by pass.
+    for pass_idx in range(1, PASSES + 1):
+        for name, cached in (("nocache", False), ("cache_warm", True)):
+            wall, stall, steps, d = run_pass(cached)
+            record_pass(arms[name], wall, stall, steps)
+            digest_sets.append(d)
+            print(json.dumps({
+                "pass": pass_idx, "arm": name, "wall_s": round(wall, 3),
+                "stall_s": round(stall, 3), "steps": steps,
+            }), flush=True)
+
+    digests_identical = all(d == digest_sets[0] for d in digest_sets)
+    out = {}
+    for name, a in arms.items():
+        rate = BATCH * a["steps"] / a["wall"] if a["wall"] else 0.0
+        stall_pct = 100.0 * a["stall"] / a["wall"] if a["wall"] else 0.0
+        out[name] = {"images_per_sec": round(rate, 2),
+                     "stall_pct": round(stall_pct, 2),
+                     "wall_s": round(a["wall"], 3)}
+    stall_cut = out["nocache"]["stall_pct"] - out["cache_warm"]["stall_pct"]
+    speedup = (
+        out["cache_warm"]["images_per_sec"]
+        / out["nocache"]["images_per_sec"]
+        if out["nocache"]["images_per_sec"] else 0.0
+    )
+    cache_stats = cache.stats()
+    counters = {
+        name: cache_reg.counter(f"cache_{name}_total").value
+        for name in ("hit", "miss", "disk_hit", "spill", "evict", "torn")
+    }
+    passed = stall_cut >= 20.0 and digests_identical
+    record = {
+        "bench": "epoch_coherent_batch_cache",
+        "arms": out,
+        "stall_cut_points": round(stall_cut, 2),
+        "speedup_warm_over_nocache": round(speedup, 3),
+        "digests_bit_identical_across_arms_and_epochs": digests_identical,
+        "digest_passes": len(digest_sets),
+        "cache_counters": counters,
+        "cache_occupancy": cache_stats,
+        "ram_budget_mb": RAM_MB,
+        "rows": ROWS, "warm_passes": PASSES, "batch": BATCH,
+        "src_size": SRC_SIZE, "out_size": OUT_SIZE,
+        "producers": PRODUCERS,
+        "basis": (
+            f"interleaved_passes_cpu_{os.cpu_count()}core_single_process_"
+            "light_step; the warm arm's remaining producer cost is a "
+            "memcpy out of cache pages plus a disk read + sha256 verify "
+            "for the spilled share (RAM ring deliberately sized below the "
+            "decoded corpus so BOTH tiers are exercised). CPU-basis wall "
+            "CREDITS the warm arm with the decode cores it frees — on a "
+            "shared decode fleet that freed capacity is the tf.data-"
+            "service multi-tenant win; the stall-cut clause is the "
+            "basis-independent signal (the BENCH_H2D_r07 precedent)"
+        ),
+        "acceptance": (
+            "warm cache arm cuts loader stall >= 20 points vs the "
+            "no-cache arm; per-step digests bit-identical across arms "
+            "and across cold/warm epochs"
+        ),
+        "passed": passed,
+    }
+    print(json.dumps(record, indent=2), flush=True)
+    with open(OUT_PATH, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {OUT_PATH}", file=sys.stderr)
+    cache.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+    if not passed:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -174,6 +174,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--device_cache_gb", type=float, default=8.0,
                    help="fall back to streaming when the projected resident "
                         "size exceeds this")
+    bc = p.add_mutually_exclusive_group()
+    bc.add_argument("--batch_cache", action="store_true",
+                    help="epoch-coherent decoded-batch cache (tiered "
+                         "RAM/disk, data/cache.py): epoch >= 2 and "
+                         "restarted runs stream byte-identical cached "
+                         "batches instead of re-reading + re-decoding; "
+                         "content-keyed, so the stream is bit-identical "
+                         "to the uncached run")
+    bc.add_argument("--no_batch_cache", action="store_true",
+                    help="force the uncached decode path — the control "
+                         "arm against --batch_cache (this is also the "
+                         "default)")
+    p.add_argument("--cache_ram_budget_mb", type=int, default=512,
+                   help="batch-cache RAM ring budget (BufferPool-leased "
+                        "pages; LRU spill to disk over budget); a live "
+                        "autotuner Tunable")
+    p.add_argument("--cache_disk_budget_mb", type=int, default=2048,
+                   help="batch-cache disk-spill budget (atomic "
+                        "sha256-verified segments; oldest evicted over "
+                        "budget); a live autotuner Tunable")
+    p.add_argument("--cache_dir", type=str, default=None,
+                   help="batch-cache spill directory (default "
+                        "~/.cache/<pkg>/batch-cache — stable across "
+                        "restarts, so resumed runs start warm)")
     p.add_argument("--shuffle", action="store_true",
                    help="iterable path: reshuffle batch order every epoch "
                         "(same permutation on every process)")
@@ -297,6 +321,19 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "(entropy-only host decode) instead of finished "
                         "pixels — trainers must also run --device_decode "
                         "(the HELLO is skew-checked); classification only")
+    p.add_argument("--batch_cache", action="store_true",
+                   help="epoch-coherent decoded-batch cache (tiered "
+                        "RAM/disk): a second epoch, a reconnected "
+                        "trainer, or a second client streaming the same "
+                        "plan is served from cache — no fragment read, "
+                        "no decode; content-keyed, stream bit-identical")
+    p.add_argument("--cache_ram_budget_mb", type=int, default=512,
+                   help="batch-cache RAM ring budget (MiB)")
+    p.add_argument("--cache_disk_budget_mb", type=int, default=2048,
+                   help="batch-cache disk-spill budget (MiB)")
+    p.add_argument("--cache_dir", type=str, default=None,
+                   help="batch-cache spill directory (default "
+                        "~/.cache/<pkg>/batch-cache)")
     p.add_argument("--queue_depth", type=int, default=4,
                    help="bounded per-client batch queue (backpressure)")
     p.add_argument("--handshake_timeout_s", type=float, default=30.0,
@@ -468,6 +505,10 @@ def serve_main(argv=None) -> dict:
         shm_workers=not args.no_shm_workers,
         buffer_pool=not args.no_buffer_pool,
         device_decode=args.device_decode,
+        batch_cache=args.batch_cache,
+        cache_ram_budget_mb=args.cache_ram_budget_mb,
+        cache_disk_budget_mb=args.cache_disk_budget_mb,
+        cache_dir=args.cache_dir,
         queue_depth=args.queue_depth,
         handshake_timeout_s=args.handshake_timeout_s,
         read_retries=args.read_retries,
@@ -634,6 +675,10 @@ def main(argv=None) -> dict:
         data_echo=args.data_echo,
         device_cache=args.device_cache,
         device_cache_gb=args.device_cache_gb,
+        batch_cache=args.batch_cache and not args.no_batch_cache,
+        cache_ram_budget_mb=args.cache_ram_budget_mb,
+        cache_disk_budget_mb=args.cache_disk_budget_mb,
+        cache_dir=args.cache_dir,
         shuffle=args.shuffle,
         augment=not args.no_augment,
         eval_at_end=not args.no_eval_at_end,

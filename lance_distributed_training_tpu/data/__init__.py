@@ -32,6 +32,15 @@ from .authoring import (  # noqa: F401
     create_text_token_dataset,
     ingest_on_process_zero,
 )
+from .cache import (  # noqa: F401
+    BatchCache,
+    DeviceReplayCache,
+    PlanCache,
+    decode_fingerprint,
+    folder_fingerprint,
+    item_fingerprint,
+    plan_fingerprint,
+)
 from .filters import parse_predicate, predicate_mask  # noqa: F401
 from .folder import FolderDataPipeline  # noqa: F401
 from .placement import PlacedLoader, PlacementPlane  # noqa: F401

@@ -120,6 +120,18 @@ class ImageClassificationDecoder:
             cols.append(self.label_column)
         return cols
 
+    def cache_fingerprint(self) -> str:
+        """Batch-cache identity (``data/cache.py``): everything that can
+        change the BYTES this decoder emits. Native availability is
+        included — libjpeg and the PIL fallback decode to slightly
+        different pixels, so a cache written by one must never hit in a
+        process running the other."""
+        return (
+            f"ImageClassificationDecoder/{self.image_size}/"
+            f"{self.image_column}/{self.label_column}/"
+            f"native={self._native is not None}"
+        )
+
     def _bind_native(self) -> None:
         self._native = None
         self._native_arrow = None
@@ -268,6 +280,9 @@ class ImageTextDecoder:
     @buffer_pool.setter
     def buffer_pool(self, pool) -> None:
         self._image.buffer_pool = pool
+
+    def cache_fingerprint(self) -> str:
+        return f"ImageTextDecoder/{self._image.cache_fingerprint()}"
 
     def __call__(
         self, batch: Union[pa.RecordBatch, pa.Table]

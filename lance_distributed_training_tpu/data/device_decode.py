@@ -127,6 +127,17 @@ class CoeffImageDecoder:
             cols.append(self.label_column)
         return cols
 
+    def cache_fingerprint(self) -> str:
+        """Batch-cache identity (``data/cache.py``). ``chunk_blocks`` is
+        included because the grid rounding shapes the PAGE bytes (not the
+        decoded image): an autotuner ``coeff_chunk`` actuation therefore
+        changes the key space and old entries simply stop hitting —
+        capacity moved, content never aliased."""
+        return (
+            f"CoeffImageDecoder/{self.image_size}/{self.image_column}/"
+            f"{self.label_column}/chunk={self.chunk_blocks}"
+        )
+
     # -- autotune surface --------------------------------------------------
 
     def set_chunk(self, blocks: int) -> int:

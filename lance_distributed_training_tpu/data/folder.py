@@ -77,10 +77,24 @@ class FolderDataPipeline:
         workers=None,
         producers: int = 1,
         buffer_pool=None,
+        batch_cache=None,
+        dataset_fingerprint=None,
     ):
         self.samples, self.classes = _folder_samples(root)
         if not self.samples:
             raise ValueError(f"no images under {root}")
+        # Content identity of the walk-ordered corpus: hashed at most ONCE
+        # per pipeline and reused for every epoch's batch-cache keys (each
+        # __iter__ builds a fresh inner pipeline; re-hashing per epoch was
+        # the fingerprint-churn bug the r13 satellite fixed) — and lazily,
+        # so cacheless runs over million-file corpora never pay the
+        # full-tree stat+hash at all (see dataset_fingerprint). A caller
+        # that already computed it (the trainer does, once per RUN, and
+        # rebuilds this pipeline per epoch) injects it here.
+        self._dataset_fingerprint: Optional[str] = (
+            str(dataset_fingerprint)
+            if dataset_fingerprint is not None else None
+        )
         if loader_style not in ("map", "iterable"):
             raise ValueError(
                 f"loader_style must be 'map' or 'iterable', got {loader_style!r}"
@@ -99,6 +113,7 @@ class FolderDataPipeline:
         self.workers = workers
         self.producers = producers
         self.buffer_pool = buffer_pool
+        self.batch_cache = batch_cache
         self._start_step = 0
         self._yielded = 0
 
@@ -126,6 +141,16 @@ class FolderDataPipeline:
     @property
     def num_classes(self) -> int:
         return len(self.classes)
+
+    @property
+    def dataset_fingerprint(self) -> str:
+        """Corpus content identity (``cache.folder_fingerprint``),
+        computed on first use and cached for the pipeline's lifetime."""
+        if self._dataset_fingerprint is None:
+            from .cache import folder_fingerprint
+
+            self._dataset_fingerprint = folder_fingerprint(self.samples)
+        return self._dataset_fingerprint
 
     def _index_batches(self) -> list[np.ndarray]:
         if self.loader_style == "iterable":
@@ -159,6 +184,24 @@ class FolderDataPipeline:
     def _read(self, idx_batch: np.ndarray):
         return read_sample_batch(self.samples, idx_batch)
 
+    def _plan_cache(self):
+        """Per-epoch cache binding over the construction-time fingerprint.
+        Iterable-style epochs shuffle batch ORDER only, so their index
+        batches replay identical content every epoch — all hits from
+        epoch 2 regardless of the permutation; map-style row reshuffles
+        miss honestly (item-content keys)."""
+        if self.batch_cache is None:
+            return None
+        from .cache import PlanCache, decode_fingerprint, plan_fingerprint
+
+        return PlanCache(
+            self.batch_cache,
+            self.dataset_fingerprint,
+            lambda: plan_fingerprint(
+                decode=decode_fingerprint(self.decode_fn)
+            ),
+        )
+
     def __iter__(self) -> Iterator[dict]:
         from .pipeline import DataPipeline
 
@@ -172,6 +215,7 @@ class FolderDataPipeline:
             workers=self.workers,
             producers=self.producers,
             buffer_pool=self.buffer_pool,
+            plan_cache=self._plan_cache(),
         )
         pipe.load_state_dict({"step": self._start_step})
         self._yielded = self._start_step

@@ -29,6 +29,7 @@ property upstream's ``SafeLanceDataset`` exists to provide
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import tempfile
@@ -200,6 +201,44 @@ class Dataset:
         ).astype(np.int64)
         self._readers: dict[int, _FragmentReader] = {}
         self._lock = threading.Lock()
+        # Content identity, computed ONCE at construction (manifest
+        # metadata plus one os.stat per fragment FILE — a handful of
+        # stats, not a data read) and reused for every batch-cache key
+        # and HELLO skew check; per-epoch recomputation was the
+        # fingerprint-churn bug the r13 satellite fixed. Version + schema
+        # + fragment table + fragment sizes: a rewritten/appended/
+        # regenerated-in-place dataset at the same URI gets a new
+        # fingerprint, so stale cache hits are impossible.
+        h = hashlib.sha256()
+        h.update(str(self.version).encode())
+        h.update(manifest["schema"].encode())
+        for frag in self.fragments:
+            # Fragment FILE size rides along (one stat per fragment):
+            # a dataset regenerated in place with the same version/names/
+            # row counts still gets a new identity, so the batch cache's
+            # restart-persistent disk tier can never serve the old bytes.
+            # Size, deliberately NOT mtime: two hosts mounting (or
+            # rsync'ing) the same data must agree on the fingerprint or
+            # the HELLO skew check would reject legitimate disaggregated
+            # setups. Residual blind spot: a byte-different rewrite of
+            # identical length — realistic rewrites change IPC sizes.
+            try:
+                size = os.path.getsize(frag.path)
+            except OSError:
+                size = -1
+            h.update(
+                f"{frag.fragment_id}:{os.path.basename(frag.path)}:"
+                f"{frag.num_rows}:{size};".encode()
+            )
+        self._fingerprint = h.hexdigest()
+
+    def fingerprint(self) -> str:
+        """Stable content identity of this dataset snapshot — the
+        ``dataset_fingerprint`` component of batch-cache keys
+        (``data/cache.py``) and the optional HELLO skew field a client
+        declares so a data server backed by a *different* copy of "the
+        same" dataset is rejected at connect time."""
+        return self._fingerprint
 
     # -- metadata ----------------------------------------------------------
     def get_fragments(self) -> list[Fragment]:

@@ -149,6 +149,7 @@ class DataPipeline:
         workers=None,
         producers: int = 1,
         buffer_pool=None,
+        plan_cache=None,
     ):
         self.dataset = dataset
         self.plan = list(plan)
@@ -158,6 +159,14 @@ class DataPipeline:
         self.read_fn = read_fn
         self.workers = workers
         self.producers = max(1, producers)
+        # Batch-cache plane (data/cache.py): a PlanCache binding of the
+        # process BatchCache, consulted AT the decode boundary — a hit
+        # skips the fragment read AND the decode entirely and returns a
+        # byte-identical batch in fresh pool-leased pages (released by the
+        # consumer exactly like a decoded batch); a miss decodes and fills.
+        # None (the default, and the --no_batch_cache arm) is the exact
+        # pre-r13 path: no probe, no copy, nothing.
+        self.plan_cache = plan_cache
         # Buffer plane (data/buffers.py): the pool the decoder leased its
         # output pages from (and the WorkerPool its copy-out pages). This
         # pipeline owns the RELEASE side: leases go back after device_put
@@ -234,20 +243,64 @@ class DataPipeline:
     def __len__(self) -> int:
         return len(self.plan)
 
+    def _decode_item(self, item) -> dict:
+        """The decode boundary, cache-aware: a batch-cache hit returns a
+        byte-identical copy in fresh pool pages (no read, no decode); a
+        miss runs read→decode and fills the cache. The no-cache path is
+        exactly one ``None`` check."""
+        cache = self.plan_cache
+        if cache is not None:
+            hit = cache.get(item, pool=self.buffer_pool)
+            if hit is not None:
+                return hit
+        out = self.decode_fn(self.read_fn(self.dataset, item))
+        if cache is not None:
+            cache.put(item, out)
+        return out
+
     def _produce(self, q: "queue.Queue", stop: threading.Event,
                  plan: Sequence, base: int) -> None:
         """``plan`` is the resume-sliced tail; ``base`` keeps seq/lineage
         stamps absolute within the full plan."""
         try:
             if self.workers is not None:
-                it = self.workers.imap(plan)
-                for off in range(len(plan)):
+                cache = self.plan_cache
+                if cache is not None:
+                    # Probe once, decode only the misses in the pool: the
+                    # miss list keeps imap's plan-order contract, so result
+                    # k of the iterator IS the k-th probed miss. A probed
+                    # hit evicted before its fetch decodes inline (rare —
+                    # a concurrent budget shrink), never off the iterator:
+                    # consuming a worker result for a skipped item would
+                    # shift every later batch one step (silent reorder).
+                    probed = [cache.contains(item) for item in plan]
+                    it = self.workers.imap(
+                        [i for i, hit in zip(plan, probed) if not hit]
+                    )
+                else:
+                    probed = None
+                    it = self.workers.imap(plan)
+                for off, item in enumerate(plan):
                     seq = base + off
                     if stop.is_set():
                         return
                     t0 = time.monotonic_ns()
                     with span("pipeline.decode", batch_seq=seq):
-                        out = next(it)
+                        if probed is not None and probed[off]:
+                            out = cache.get(item, pool=self.buffer_pool)
+                            if out is None:  # evicted since the probe
+                                out = self.decode_fn(
+                                    self.read_fn(self.dataset, item)
+                                )
+                                cache.put(item, out)
+                        else:
+                            out = next(it)
+                            if cache is not None:
+                                # This miss never went through get():
+                                # count it, or a cold cache under workers
+                                # would report a 100% hit rate.
+                                cache.note_miss()
+                                cache.put(item, out)
                     # Worker-pool path: the producer only waits on results,
                     # so this is the pipelined arrival gap, not decode CPU.
                     decode_ms = (time.monotonic_ns() - t0) / 1e6
@@ -259,9 +312,7 @@ class DataPipeline:
                         return
                     t0 = time.monotonic_ns()
                     with span("pipeline.decode", batch_seq=seq):
-                        out = self.decode_fn(
-                            self.read_fn(self.dataset, item)
-                        )
+                        out = self._decode_item(item)
                     decode_ms = (time.monotonic_ns() - t0) / 1e6
                     q.put((make_lineage(seq, decode_ms), out))
             q.put(_SENTINEL)
@@ -387,9 +438,7 @@ class DataPipeline:
                         return
                     t0 = time.monotonic_ns()
                     with span("pipeline.decode", batch_seq=seq, producer=k):
-                        out = self.decode_fn(
-                            self.read_fn(self.dataset, item)
-                        )
+                        out = self._decode_item(item)
                         if self.device_put_fn is not None:
                             host = out
                             out = self.device_put_fn(host)
@@ -474,6 +523,7 @@ def make_train_pipeline(
     epoch: int = 0,
     columns: Optional[Sequence[str]] = None,
     buffer_pool=None,
+    batch_cache=None,
 ) -> DataPipeline:
     """Iterable-style pipeline — parity with ``get_sampler``+``get_dataset``+
     ``get_loader`` (``/root/reference/lance_iterable.py:53-72,86-88``).
@@ -508,10 +558,28 @@ def make_train_pipeline(
     else:
         plan = make_plan(sampler_type, rows, batch_size, process_index,
                          process_count, shuffle=shuffle, seed=seed, epoch=epoch)
+    plan_cache = None
+    if batch_cache is not None:
+        # Item-content keys make the binding epoch-coherent by
+        # construction: epoch e's plan items that replay epoch 0's rows
+        # hash to the SAME keys (whatever their step position), so every
+        # later epoch — shuffled batch order included — streams hits.
+        from .cache import PlanCache, decode_fingerprint, plan_fingerprint
+
+        cols = list(columns) if columns is not None else None
+        plan_cache = PlanCache(
+            batch_cache,
+            dataset.fingerprint(),
+            # Callable: evaluated per key, so a live decoder actuation
+            # (coeff_chunk) re-scopes later entries instead of aliasing.
+            lambda: plan_fingerprint(
+                decode=decode_fingerprint(decode_fn), columns=cols,
+            ),
+        )
     return DataPipeline(dataset, plan, decode_fn, device_put_fn, prefetch,
                         read_fn=_with_columns(_range_read, columns),
                         workers=workers, producers=producers,
-                        buffer_pool=buffer_pool)
+                        buffer_pool=buffer_pool, plan_cache=plan_cache)
 
 
 def make_eval_pipeline(
@@ -527,6 +595,8 @@ def make_eval_pipeline(
     producers: int = 1,
     index_pool: Optional[np.ndarray] = None,
     buffer_pool=None,
+    batch_cache=None,
+    dataset_fingerprint: Optional[str] = None,
 ) -> DataPipeline:
     """Full-coverage eval loader: every row exactly once, ONE compiled shape.
 
@@ -562,9 +632,26 @@ def make_eval_pipeline(
         out["_weight"] = weights
         return out
 
+    plan_cache = None
+    if batch_cache is not None and dataset_fingerprint is not None:
+        # The caller supplies the fingerprint it already computed ONCE
+        # (Dataset construction / FolderDataPipeline init) — eval rebuilds
+        # this loader every eval_every epochs, and recomputing the
+        # fingerprint per rebuild was the churn this satellite removed.
+        # The eval=1 scope separates eval entries (they carry _weight)
+        # from train entries over the same rows.
+        from .cache import PlanCache, decode_fingerprint, plan_fingerprint
+
+        plan_cache = PlanCache(
+            batch_cache,
+            dataset_fingerprint,
+            lambda: plan_fingerprint(
+                decode=decode_fingerprint(decode_fn), eval=1,
+            ),
+        )
     return DataPipeline(None, plan, _decode, device_put_fn, prefetch,
                         read_fn=_read, producers=producers,
-                        buffer_pool=buffer_pool)
+                        buffer_pool=buffer_pool, plan_cache=plan_cache)
 
 
 class MapStylePipeline:
@@ -595,6 +682,7 @@ class MapStylePipeline:
         columns: Optional[Sequence[str]] = None,
         index_pool: Optional[np.ndarray] = None,
         buffer_pool=None,
+        batch_cache=None,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -610,6 +698,7 @@ class MapStylePipeline:
         self.workers = workers
         self.producers = producers
         self.buffer_pool = buffer_pool
+        self.batch_cache = batch_cache
         self.columns = list(columns) if columns is not None else None
         # Optional row-filter pool (Dataset.filter_indices): shard/permute
         # POSITIONS in the pool, then map back to global rows — every process
@@ -689,6 +778,26 @@ class MapStylePipeline:
     def __len__(self) -> int:
         return len(self._index_batches())
 
+    def _plan_cache(self):
+        """Per-epoch cache binding. Map-style epochs reshuffle at ROW
+        level, so epoch e's index batches genuinely differ from epoch
+        0's — the item-content keys make that an automatic (honest) miss,
+        while unshuffled configs and repeated evals over the same pool
+        hit. The dataset fingerprint was computed once at Dataset
+        construction; reused here every epoch."""
+        if self.batch_cache is None:
+            return None
+        from .cache import PlanCache, decode_fingerprint, plan_fingerprint
+
+        return PlanCache(
+            self.batch_cache,
+            self.dataset.fingerprint(),
+            lambda: plan_fingerprint(
+                decode=decode_fingerprint(self.decode_fn),
+                columns=self.columns,
+            ),
+        )
+
     def __iter__(self) -> Iterator[dict]:
         pipe = DataPipeline(
             self.dataset,
@@ -700,6 +809,7 @@ class MapStylePipeline:
             workers=self.workers,
             producers=self.producers,
             buffer_pool=self.buffer_pool,
+            plan_cache=self._plan_cache(),
         )
         # The cursor lives HERE (this is the consumer-facing loader); the
         # inner single-shot pipeline just starts at the same offset.

@@ -339,6 +339,7 @@ class FleetLoader:
         task_type: Optional[str] = None,
         image_size: Optional[int] = None,
         device_decode: Optional[bool] = None,
+        dataset_fingerprint: Optional[str] = None,
         registry: Optional[MetricsRegistry] = None,
         buffer_pool=None,
         stripe_queue_depth: int = 2,
@@ -364,6 +365,10 @@ class FleetLoader:
         self.task_type = task_type
         self.image_size = image_size
         self.device_decode = device_decode
+        # Declared dataset identity (see RemoteLoader): every member of
+        # the fleet must serve the SAME dataset content — a stale-mirror
+        # member is rejected at its handshake, not silently striped in.
+        self.dataset_fingerprint = dataset_fingerprint
         self.registry = registry if registry is not None else default_registry()
         self.counters = ServiceCounters(prefix="fleet", registry=self.registry)
         self.buffer_pool = buffer_pool
@@ -550,6 +555,7 @@ class FleetLoader:
             task_type=self.task_type,
             image_size=self.image_size,
             device_decode=self.device_decode,
+            dataset_fingerprint=self.dataset_fingerprint,
         )
 
     def _dial_member(self, addr: str, start_step: int, stripe_index: int,

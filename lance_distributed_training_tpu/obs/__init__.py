@@ -42,6 +42,16 @@ half adds ``fleet_pressure_stall_pct_max``/``_mean`` and
 is the windowed view the controller (and bench scripts) read — deltas
 since the previous call, histogram percentiles over the window's own
 bucket increments.
+
+Batch-cache series (r13, recorded by ``data/cache.py`` into the default
+registry — README "Batch cache" for the full glossary):
+``cache_hit_total`` / ``cache_miss_total`` / ``cache_disk_hit_total`` /
+``cache_store_total`` / ``cache_spill_total`` / ``cache_evict_total`` /
+``cache_torn_total`` / ``cache_spill_errors_total`` counters, the
+``cache_ram_bytes`` / ``cache_disk_bytes`` / ``cache_ram_entries`` /
+``cache_disk_entries`` occupancy gauges, the ``cache_lookup_ms``
+histogram, and the HBM replay tier's ``cache_device_batches`` gauge +
+``cache_device_replay_epochs_total`` counter.
 """
 
 from .http import MetricsHTTPServer  # noqa: F401

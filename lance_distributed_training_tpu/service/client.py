@@ -77,6 +77,7 @@ class RemoteLoader:
         task_type: Optional[str] = None,
         image_size: Optional[int] = None,
         device_decode: Optional[bool] = None,
+        dataset_fingerprint: Optional[str] = None,
         registry: Optional[MetricsRegistry] = None,
         buffer_pool=None,
     ):
@@ -101,6 +102,10 @@ class RemoteLoader:
         self.task_type = task_type
         self.image_size = image_size
         self.device_decode = device_decode
+        # Declared dataset identity (Dataset.fingerprint() of a locally
+        # readable copy, when the trainer has one): the server rejects a
+        # mismatched copy at connect time. None = undeclared, skipped.
+        self.dataset_fingerprint = dataset_fingerprint
         self.registry = registry if registry is not None else default_registry()
         self.counters = ServiceCounters(registry=self.registry)
         # Buffer plane: received tensors are copied into recycled pool
@@ -209,6 +214,7 @@ class RemoteLoader:
             task_type=self.task_type,
             image_size=self.image_size,
             device_decode=self.device_decode,
+            dataset_fingerprint=self.dataset_fingerprint,
         )
 
     def _connect(self, start_step: int, probe: bool = False,

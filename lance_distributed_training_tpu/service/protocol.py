@@ -517,6 +517,7 @@ def hello(
     task_type: Optional[str] = None,
     image_size: Optional[int] = None,
     device_decode: Optional[bool] = None,
+    dataset_fingerprint: Optional[str] = None,
     version: int = PROTOCOL_VERSION,
 ) -> dict:
     """Build the HELLO payload — the client's shard-of-the-plan request.
@@ -563,5 +564,15 @@ def hello(
         # pixel-vs-coefficient-page serving mode.
         "device_decode": (
             bool(device_decode) if device_decode is not None else None
+        ),
+        # Content identity of the dataset the client opened locally
+        # (Dataset.fingerprint(), r13): the server rejects a mismatch —
+        # serving rows from a DIFFERENT copy of "the same" path would
+        # train on wrong data with a valid plan shape. None = the client
+        # has no local mount (disaggregated hosts) or predates the field:
+        # the check is skipped, like the decode knobs above.
+        "dataset_fingerprint": (
+            str(dataset_fingerprint)
+            if dataset_fingerprint is not None else None
         ),
     }

@@ -82,6 +82,19 @@ class ServeConfig:
     # half as their jitted device kernel (ops/jpeg_device.py). Both sides
     # must agree — the HELLO's device_decode field is skew-checked like
     # task_type/image_size. Classification only.
+    batch_cache: bool = False  # epoch-coherent decoded-batch cache
+    # (data/cache.py): hits are served straight into the sender path — a
+    # second epoch, a reconnected/restarted trainer, or a SECOND client
+    # streaming the same plan skips fragment read + decode entirely.
+    # Content-keyed (dataset fingerprint + decode config + plan item), so
+    # sharing across clients can only add hits, never wrong bytes; the
+    # stream stays bit-identical to the uncached path.
+    cache_ram_budget_mb: int = 512  # RAM ring budget (BufferPool-leased
+    # pages; evictions spill to disk, then release the leases)
+    cache_disk_budget_mb: int = 2048  # local-disk spill budget (atomic
+    # sha256-verified segment files; survives restarts)
+    cache_dir: Optional[str] = None  # spill directory (default:
+    # ~/.cache/<pkg>/batch-cache — stable, so restarts start warm)
     queue_depth: int = 4  # per-client bounded batch queue
     handshake_timeout_s: float = 30.0  # HELLO recv deadline per connection
     read_retries: int = 3  # dataset-read attempts before ERROR
@@ -384,21 +397,51 @@ class _ClientSession:
         svc = self.service
         try:
             items = [plan[s] for s in steps]
+            columns = req.get("columns")
+            # Batch-cache binding for this session's plan (None when the
+            # cache is off): hits skip read+decode and serve straight into
+            # the sender queue — the epoch-2 / second-client / reconnect
+            # fast path. Worker-pool decode gets only the probed misses
+            # (imap stays plan-ordered over that miss list); a probed hit
+            # evicted before its fetch decodes inline, never off the
+            # iterator — consuming a pool result for a skipped item would
+            # shift every later step (silent reorder).
+            cache = svc.plan_cache_for(req)
+            miss_iter = None
+            probed = None
             if svc.workers is not None:
-                results = svc.workers.imap(items)
-            else:
-                columns = req.get("columns")
-                results = (
-                    svc.decode_fn(svc.read_item(item, columns))
-                    for item in items
-                )
-            it = iter(results)
-            for step in steps:
+                to_decode = items
+                if cache is not None:
+                    probed = [cache.contains(item) for item in items]
+                    to_decode = [
+                        i for i, hit in zip(items, probed) if not hit
+                    ]
+                miss_iter = iter(svc.workers.imap(to_decode))
+            for off, step in enumerate(steps):
                 if self._stop.is_set():
                     return
+                item = items[off]
                 t0 = time.monotonic_ns()
                 with span("svc.decode", step=step):
-                    batch = next(it)
+                    if miss_iter is not None and not (
+                        probed is not None and probed[off]
+                    ):
+                        batch = next(miss_iter)
+                        if cache is not None:
+                            # A probed miss never went through get():
+                            # count it for an honest hit rate.
+                            cache.note_miss()
+                            cache.put(item, batch)
+                    else:
+                        batch = None
+                        if cache is not None:
+                            batch = cache.get(item, pool=svc.buffer_pool)
+                        if batch is None:
+                            batch = svc.decode_fn(
+                                svc.read_item(item, columns)
+                            )
+                            if cache is not None:
+                                cache.put(item, batch)
                 decode_ms = (time.monotonic_ns() - t0) / 1e6
                 svc.counters.observe("decode_ms", decode_ms)
                 lineage = make_lineage(step, decode_ms)
@@ -473,6 +516,20 @@ class DataService:
             device_decode=config.device_decode,
         )
         self.counters = ServiceCounters()
+        # Epoch-coherent batch cache (ServeConfig.batch_cache): one tiered
+        # RAM/disk cache shared by every client session — the tf.data
+        # service "cache the materialized batches behind the plan key"
+        # lever, server-side so RemoteLoader AND FleetLoader inherit it.
+        self.batch_cache = None
+        if config.batch_cache:
+            from ..data.cache import BatchCache
+
+            self.batch_cache = BatchCache(
+                cache_dir=config.cache_dir,
+                ram_budget_mb=config.cache_ram_budget_mb,
+                disk_budget_mb=config.cache_disk_budget_mb,
+                buffer_pool=self.buffer_pool,
+            )
         self.workers = None
         if config.num_workers > 0:
             from ..data.workers import WorkerPool, columnar_spec
@@ -601,7 +658,46 @@ class DataService:
                 f"device_decode={bool(cfg.device_decode)}, client expects "
                 f"{bool(dd)}"
             )
+        fp = req.get("dataset_fingerprint")
+        if fp is not None and str(fp) != self.dataset.fingerprint():
+            # The client opened the dataset locally and declared its
+            # content identity: a server reading a DIFFERENT copy of "the
+            # same" path (stale mirror, mid-rewrite snapshot) would stream
+            # rows from the wrong data with a perfectly valid plan shape.
+            # Reject at connect time, like the decode knobs. None = the
+            # client has no local mount (or an old peer): skipped.
+            return (
+                "dataset skew: server dataset fingerprint "
+                f"{self.dataset.fingerprint()[:12]}..., client declares "
+                f"{str(fp)[:12]}..."
+            )
         return None
+
+    def plan_cache_for(self, req: dict):
+        """This handshake's :class:`~..data.cache.PlanCache` binding of the
+        shared batch cache (``None`` when the cache is off). The scope
+        carries the decode fingerprint + column projection; plan items are
+        content-hashed, so two clients (or two epochs, or a reconnect)
+        asking for the same rows share entries."""
+        if self.batch_cache is None:
+            return None
+        from ..data.cache import (
+            PlanCache,
+            decode_fingerprint,
+            plan_fingerprint,
+        )
+
+        columns = req.get("columns")
+        cols = list(columns) if columns is not None else None
+        return PlanCache(
+            self.batch_cache,
+            self.dataset.fingerprint(),
+            # Callable: re-evaluated per key, so live decoder knob moves
+            # re-scope later entries instead of aliasing old-geometry ones.
+            lambda: plan_fingerprint(
+                decode=decode_fingerprint(self.decode_fn), columns=cols,
+            ),
+        )
 
     def plan_for(self, req: dict):
         """This shard's epoch plan — identical to the in-process pipeline's
@@ -873,6 +969,11 @@ class DataService:
         if self.workers is not None:
             self.workers.shutdown()
             self.workers = None
+        if self.batch_cache is not None:
+            # After the sessions are closed: no producer can be mid-get.
+            # Releases the RAM ring's pool leases; the disk tier stays
+            # (it is the restart-warm path).
+            self.batch_cache.close()
 
     def __enter__(self) -> "DataService":
         return self.start() if self._sock is None else self

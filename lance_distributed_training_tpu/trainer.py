@@ -193,6 +193,24 @@ class TrainConfig:
     # permutation (membership frozen at epoch 0).
     device_cache_gb: float = 8.0  # projected-size guard: fall back to the
     # streaming path (with a warning) when the dataset won't fit
+    batch_cache: bool = False  # epoch-coherent decoded-batch cache
+    # (data/cache.py): a tiered RAM/disk plane consulted at every local
+    # loader's decode boundary — epoch >= 2 (and a restarted run, via the
+    # disk tier) streams byte-identical cached batches instead of
+    # re-reading fragments and re-running decode. Content-keyed (dataset
+    # fingerprint + decode config + plan item), so the stream is
+    # bit-identical to the uncached run by construction. Host tier of the
+    # same idea device_cache implements in HBM; the two compose (the
+    # batch cache feeds the fill epoch). False (--no_batch_cache) = the
+    # exact r12 path: no probe, no spill dir, nothing.
+    cache_ram_budget_mb: int = 512  # RAM ring budget (BufferPool-leased
+    # pages; LRU eviction spills to disk, then releases the leases) — a
+    # bounded Tunable the autotuner can actuate
+    cache_disk_budget_mb: int = 2048  # local-disk spill budget (atomic,
+    # sha256-verified segment files; oldest evicted over budget) — Tunable
+    cache_dir: Optional[str] = None  # spill directory; default
+    # ~/.cache/<pkg>/batch-cache (stable across restarts on purpose:
+    # that is what makes a resumed job's first epoch decode-free)
     compile_cache: bool = True  # persistent XLA compile cache on accelerator
     # backends (a cold remote-TPU ResNet-50 compile is minutes; warm starts
     # are seconds). Never applies on CPU — see maybe_enable_compile_cache.
@@ -636,7 +654,8 @@ def _make_placement(config: TrainConfig, mesh):
 
 
 def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
-                  workers=None, index_pool=None):
+                  workers=None, index_pool=None, batch_cache=None,
+                  folder_fp=None):
     process_index, process_count = process_topology()
     per_process = config.batch_size // process_count
     if per_process * process_count != config.batch_size:
@@ -677,6 +696,12 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             task_type=config.task_type,
             image_size=config.image_size,
             device_decode=config.device_decode,
+            # Dataset-identity skew check (r13): when this host can read
+            # the dataset too, declare its fingerprint so a server backed
+            # by a DIFFERENT copy is rejected at connect time.
+            dataset_fingerprint=(
+                dataset.fingerprint() if dataset is not None else None
+            ),
             buffer_pool=_loader_buffer_pool(config),
         )
         if config.coordinator_addr:
@@ -733,6 +758,8 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             workers=workers,
             producers=config.producer_threads,
             buffer_pool=_loader_buffer_pool(config),
+            batch_cache=batch_cache,
+            dataset_fingerprint=folder_fp,
         )
         if len(loader) == 0:
             raise ValueError("folder smaller than one global batch")
@@ -778,6 +805,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             columns=columns,
             index_pool=index_pool,
             buffer_pool=_loader_buffer_pool(config),
+            batch_cache=batch_cache,
         )
     else:
         loader = make_train_pipeline(
@@ -796,6 +824,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             epoch=epoch,
             columns=columns,
             buffer_pool=_loader_buffer_pool(config),
+            batch_cache=batch_cache,
         )
     if len(loader) == 0:
         raise ValueError(
@@ -844,7 +873,8 @@ def _split_val_pool(config: TrainConfig, dataset, index_pool):
     return np.sort(pool[perm[n_val:]]), np.sort(pool[perm[:n_val]])
 
 
-def _build_eval_loader(config: TrainConfig, dataset, mesh, index_pool=None):
+def _build_eval_loader(config: TrainConfig, dataset, mesh, index_pool=None,
+                       batch_cache=None, folder_fp=None):
     """Full-coverage eval loader: every row exactly once per eval, the tail
     batch padded by wrap-around rows carried with ``_weight`` 0.0 — single
     compiled batch shape, equal step counts on every process (r3 verdict:
@@ -874,6 +904,14 @@ def _build_eval_loader(config: TrainConfig, dataset, mesh, index_pool=None):
             return read_sample_batch(samples, idx)
 
         total = len(samples)
+        # The run-scoped fingerprint train() computed once; the direct-
+        # call fallback (library users) derives it here, still only when
+        # a cache is actually bound.
+        dataset_fp = folder_fp
+        if dataset_fp is None and batch_cache is not None:
+            from .data.cache import folder_fingerprint
+
+            dataset_fp = folder_fingerprint(samples)
     else:
         columns = getattr(decode, "required_columns", None)
 
@@ -881,6 +919,10 @@ def _build_eval_loader(config: TrainConfig, dataset, mesh, index_pool=None):
             return dataset.take(idx, columns=columns)
 
         total = dataset.count_rows()
+        # The fingerprint was computed once at Dataset construction —
+        # eval rebuilds this loader every eval_every epochs and must
+        # REUSE it, not re-derive it (the r13 satellite).
+        dataset_fp = dataset.fingerprint()
         if config.filter and index_pool is None:
             index_pool = dataset.filter_indices(config.filter)
     loader = make_eval_pipeline(
@@ -895,48 +937,10 @@ def _build_eval_loader(config: TrainConfig, dataset, mesh, index_pool=None):
         producers=config.producer_threads,
         index_pool=index_pool,
         buffer_pool=_loader_buffer_pool(config),
+        batch_cache=batch_cache,
+        dataset_fingerprint=dataset_fp,
     )
     return plane.wrap(loader) if plane is not None else loader
-
-
-def _per_device_batch_bytes(batch) -> int:
-    """Bytes ONE device keeps resident for a cached batch.
-
-    Cached batches are global ``jax.Array``s sharded over the mesh, so the
-    HBM cost per chip is the device's shard — not the logical global size
-    (which would wrongly reject an ~11 GB decoded FOOD101 on an 8-chip mesh
-    whose per-chip share is ~1.4 GB). Per leaf this takes the max of any one
-    local device's resident bytes, so replicated leaves count at full size
-    and uneven layouts count their worst device.
-    """
-    total = 0
-    for leaf in jax.tree_util.tree_leaves(batch):
-        shards = getattr(leaf, "addressable_shards", None)
-        if shards:
-            per_dev: dict = {}
-            for s in shards:
-                per_dev[s.device] = per_dev.get(s.device, 0) + s.data.nbytes
-            total += max(per_dev.values())
-        else:
-            # Host numpy leaf (no_ddp path): lives whole on the one device.
-            total += leaf.nbytes
-    return total
-
-
-def _device_cache_budget_bytes(config: TrainConfig) -> float:
-    """Per-device cache budget: ``device_cache_gb``, further clamped to the
-    backend-reported free HBM (``bytes_limit - bytes_in_use`` with 10%
-    headroom for activations/fragmentation) when the runtime exposes
-    ``memory_stats`` (TPU does; CPU returns None)."""
-    budget = config.device_cache_gb * 1e9
-    try:
-        stats = jax.local_devices()[0].memory_stats()
-    except Exception:  # noqa: BLE001 — stats are best-effort telemetry
-        stats = None
-    if stats and stats.get("bytes_limit"):
-        free = stats["bytes_limit"] - stats.get("bytes_in_use", 0)
-        budget = min(budget, max(free, 0) * 0.9)
-    return budget
 
 
 def maybe_enable_compile_cache(platform: str, cache_dir: Optional[str] = None,
@@ -1322,6 +1326,8 @@ def train(config: TrainConfig) -> dict:
     # /healthz liveness body, for the lifetime of the run.
     exporter = None
     worker_pool = None
+    batch_cache = None
+    folder_fp = None  # folder-corpus fingerprint, computed once per run
     tuner = None
     run_exc: Optional[BaseException] = None
     try:
@@ -1344,6 +1350,34 @@ def train(config: TrainConfig) -> dict:
             logger.log({"metrics_port": exporter.port}, to_wandb=False)
         if not (config.data_service_addr or config.coordinator_addr):
             worker_pool = _make_worker_pool(config, dataset)
+            if config.batch_cache:
+                # Epoch-coherent batch cache (--batch_cache): ONE tiered
+                # RAM/disk cache for the whole run — the epoch loop
+                # rebuilds loaders, the cache outlives them, which is the
+                # entire point (epoch >= 2 hits what epoch 1 filled).
+                # Remote arms skip it: the cache lives server-side there
+                # (ServeConfig.batch_cache), where the decode boundary is.
+                from .data.cache import BatchCache
+
+                batch_cache = BatchCache(
+                    cache_dir=config.cache_dir,
+                    ram_budget_mb=config.cache_ram_budget_mb,
+                    disk_budget_mb=config.cache_disk_budget_mb,
+                    buffer_pool=_loader_buffer_pool(config),
+                )
+                if config.data_format == "folder":
+                    # Folder-corpus identity, ONCE per run: the loaders
+                    # (train, rebuilt per epoch) and every eval-loader
+                    # rebuild reuse this instead of re-walking + re-
+                    # hashing the tree — on a million-file corpus that
+                    # stat+sha sweep per epoch is the churn the r13
+                    # satellite exists to prevent.
+                    from .data.authoring import _folder_samples
+                    from .data.cache import folder_fingerprint
+
+                    folder_fp = folder_fingerprint(
+                        _folder_samples(config.dataset_path)[0]
+                    )
         if config.autotune:
             # Closed-loop pipeline autotuning (tune/): one controller for
             # the whole run; the epoch loop re-registers each rebuilt
@@ -1362,7 +1396,7 @@ def train(config: TrainConfig) -> dict:
             resume_epoch_step=resume_epoch_step,
             resume_global_step=resume_global_step,
             preempt=preempt, chaos=chaos, trace=trace, journal=journal,
-            tuner=tuner,
+            tuner=tuner, batch_cache=batch_cache, folder_fp=folder_fp,
         )
     except BaseException as exc:
         run_exc = exc
@@ -1381,6 +1415,11 @@ def train(config: TrainConfig) -> dict:
             exporter.stop()
         if worker_pool is not None:
             worker_pool.shutdown()
+        if batch_cache is not None:
+            # After the loaders are down (the loop exited; producers
+            # drained): releases the RAM ring's BufferPool leases. The
+            # disk tier stays — it is what makes a restarted run warm.
+            batch_cache.close()
         try:
             if ckpt is not None:
                 # The crash-path save gap (r8): a preempted OR crashed run
@@ -1416,7 +1455,8 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 total_start, n_devices, results, global_step, profiling,
                 index_pool=None, lr_fn=None, val_pool=None, *,
                 resume_epoch_step=0, resume_global_step=0, preempt=None,
-                chaos=None, trace=None, journal=None, tuner=None):
+                chaos=None, trace=None, journal=None, tuner=None,
+                batch_cache=None, folder_fp=None):
     if journal is None:
         journal = _CkptJournal(resume_global_step)
     # Device-decode transform stage (--device_decode): one jitted kernel
@@ -1450,10 +1490,18 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             # Eval loaders share the decoder, so their batches carry
             # coefficient pages too (plus _weight, which passes through).
             return _inner(state, _tx(batch))
-    # HBM-resident dataset cache (--device_cache): filled on the first
-    # executed epoch, replayed afterwards. See TrainConfig.device_cache.
-    cache: list = []
-    cache_ok = config.device_cache
+    # HBM replay tier (--device_cache): epoch-``start`` batches kept on
+    # device, replayed afterwards — the fill/replay/size-guard/partial-
+    # epoch-exclusion rules now live in the cache plane
+    # (data/cache.DeviceReplayCache) next to the host tiers', not as a
+    # bespoke list here. See TrainConfig.device_cache.
+    from .data.cache import DeviceReplayCache
+
+    dev_cache = DeviceReplayCache(
+        enabled=config.device_cache,
+        budget_gb=config.device_cache_gb,
+        seed=config.seed,
+    )
     history: list = []  # per-epoch metrics, returned as results["history"]
     # Schedule position survives resume inside the restored optimizer state;
     # the lr telemetry must count from there, not from this run's step 0.
@@ -1475,19 +1523,19 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
         # Mid-epoch resume cursor: batches of THIS epoch already consumed
         # by the checkpointed run (first epoch after a restart only).
         resume_step = resume_epoch_step if epoch == start_epoch else 0
-        replay = cache_ok and epoch > start_epoch and len(cache) > 0
+        replay_it = dev_cache.replay_iter(
+            epoch, start_epoch,
+            shuffled=config.shuffle or config.loader_style == "map",
+        )
+        replay = replay_it is not None
         if replay:
-            if config.shuffle or config.loader_style == "map":
-                order = np.random.default_rng(
-                    config.seed + epoch
-                ).permutation(len(cache))
-                it = iter([cache[i] for i in order])
-            else:
-                it = iter(list(cache))
+            it = replay_it
             loader = None
         else:
             loader = _build_loader(config, dataset, mesh, epoch, worker_pool,
-                                   index_pool=index_pool)
+                                   index_pool=index_pool,
+                                   batch_cache=batch_cache,
+                                   folder_fp=folder_fp)
             if resume_step:
                 # Position the loader at the cursor: the rebuilt plan is
                 # deterministic, so the tail it serves is bit-identical to
@@ -1513,11 +1561,11 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
 
             tuner.set_tunables(collect_tunables(
                 loader, worker_pool, _loader_buffer_pool(config),
+                batch_cache,
             ) if loader is not None else [])
-        # A partially-resumed epoch must not seed the replay cache: it
-        # would capture only the post-resume tail and later epochs would
-        # silently train on a subset.
-        filling = cache_ok and not replay and not resume_step
+        # Partial-epoch exclusion (PR 7) lives in the cache plane now: a
+        # resumed epoch never seeds the replay set.
+        filling = dev_cache.start_fill(replay, resume_step)
         timer.reset()
         epoch_start = time.perf_counter()
         loss_sum = jnp.zeros((), jnp.float32)  # stays on device all epoch
@@ -1560,25 +1608,23 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                         device_ms_hist.observe(dt_ms)
             epoch_batches += 1
             if filling:
-                if not cache:
-                    per_batch = _per_device_batch_bytes(batch)
-                    projected = per_batch * len(loader)
-                    budget = _device_cache_budget_bytes(config)
-                    if projected > budget:
-                        cache_ok = False
-                        filling = False
-                        logger.log(
-                            {
-                                "device_cache": "disabled",
-                                "projected_per_device_gb": round(
-                                    projected / 1e9, 3
-                                ),
-                                "limit_per_device_gb": round(budget / 1e9, 3),
-                            },
-                            to_wandb=False,
-                        )
-                if filling:
-                    cache.append(batch)
+                refused = dev_cache.admit(batch, len(loader))
+                if refused is not None:
+                    # First-batch projection over budget: the cache plane
+                    # disabled itself; report why, keep streaming.
+                    filling = False
+                    logger.log(
+                        {
+                            "device_cache": "disabled",
+                            "projected_per_device_gb": round(
+                                refused["projected"] / 1e9, 3
+                            ),
+                            "limit_per_device_gb": round(
+                                refused["budget"] / 1e9, 3
+                            ),
+                        },
+                        to_wandb=False,
+                    )
             if (
                 config.profile_dir
                 and epoch == start_epoch
@@ -1788,6 +1834,7 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
         if config.eval_every and (epoch + 1) % config.eval_every == 0:
             val_loader = _build_eval_loader(
                 config, eval_dataset, mesh, index_pool=eval_pool,
+                batch_cache=batch_cache, folder_fp=folder_fp,
             )
             epoch_metrics["val_acc"] = evaluate(state, val_loader, eval_step)
         logger.log(epoch_metrics, step=epoch)
@@ -1830,7 +1877,8 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             else "train_acc"
         )
         loader = _build_eval_loader(
-            config, eval_dataset, mesh, index_pool=eval_pool
+            config, eval_dataset, mesh, index_pool=eval_pool,
+            batch_cache=batch_cache, folder_fp=folder_fp,
         )
         results[key] = evaluate(state, loader, eval_step)
         logger.log({key: results[key]})

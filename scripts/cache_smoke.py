@@ -1,0 +1,171 @@
+"""CI batch-cache smoke: a two-epoch --batch_cache train with a LIVE
+/metrics scrape, a bit-identical no-cache control arm, and leak-clean
+teardown.
+
+Asserts:
+
+1. a two-epoch ``--batch_cache`` train run serves ``cache_hit_total > 0``
+   (epoch 2 streams hits) plus the ``cache_lookup_ms`` histogram and
+   occupancy gauges on a LIVE /metrics scrape, polled while the trainer
+   runs;
+2. the run's per-step batch digests (``LDT_STEP_TRACE_PATH``) are
+   bit-identical, step for step, to a ``--no_batch_cache`` control arm —
+   the cache is a capacity move, never a content move;
+3. zero leaked BufferPool leases under the leak sanitizer (eviction and
+   close released every cache-entry page) and zero stray spill temp
+   files in the cache dir (every spill committed via ``os.replace`` or
+   was cleaned up).
+
+Equivalent by hand::
+
+    ldt train --dataset_path <ds> --batch_cache --metrics_port 9464 \
+        --cache_dir /tmp/bc --epochs 2 ... &
+    curl -s localhost:9464/metrics | grep cache_hit_total
+"""
+
+import gc
+import json
+import os
+import pathlib
+import tempfile
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("LDT_LEAK_SANITIZER", "1")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from lance_distributed_training_tpu.data.authoring import (  # noqa: E402
+    create_synthetic_classification_dataset,
+)
+from lance_distributed_training_tpu.obs.http import (  # noqa: E402
+    MetricsHTTPServer,
+)
+from lance_distributed_training_tpu.obs.registry import (  # noqa: E402
+    default_registry,
+)
+from lance_distributed_training_tpu.utils import leaktrack  # noqa: E402
+from lance_distributed_training_tpu.utils.chaos import read_trace  # noqa: E402
+
+SIZE = 32
+
+
+def _train(ds_uri: str, cache_dir: str, trace_path: str, cached: bool,
+           results: dict) -> None:
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    os.environ["LDT_STEP_TRACE_PATH"] = trace_path
+    try:
+        results["train"] = train(TrainConfig(
+            dataset_path=ds_uri, task_type="classification", num_classes=10,
+            image_size=SIZE, batch_size=16, epochs=2, no_wandb=True,
+            eval_at_end=False, autotune=False, log_every=0,
+            model_name="resnet18", lr=0.01,
+            batch_cache=cached, cache_dir=cache_dir,
+            # ram budget 0: EVERY entry spills, so epoch 2 streams from
+            # the disk tier — the smoke then gates the atomic-spill and
+            # sha256-verify paths, not just the friendly RAM ring.
+            cache_ram_budget_mb=0,
+        ))
+    finally:
+        os.environ.pop("LDT_STEP_TRACE_PATH", None)
+
+
+def main() -> None:
+    leaktrack.enable()
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="ldt-ci-cache-"))
+    ds = create_synthetic_classification_dataset(
+        str(tmp / "ds"), rows=96, num_classes=10, image_size=48,
+        fragment_size=48, unique_images=24, seed=7,
+    )
+    cache_dir = str(tmp / "batch-cache")
+
+    # -- 1: live /metrics during a --batch_cache train --------------------
+    exporter = MetricsHTTPServer(default_registry(), port=0).start()
+    results: dict = {}
+    t = threading.Thread(
+        target=_train,
+        args=(ds.uri, cache_dir, str(tmp / "cached.jsonl"), True, results),
+        daemon=True,
+    )
+    t.start()
+    base = f"http://127.0.0.1:{exporter.port}"
+    wanted = ("cache_hit_total", "cache_store_total",
+              "cache_lookup_ms_count", "cache_ram_bytes")
+    deadline = time.monotonic() + 240
+    live = ""
+    while time.monotonic() < deadline:
+        live = urllib.request.urlopen(
+            f"{base}/metrics", timeout=10
+        ).read().decode()
+        if all(s in live for s in wanted) and t.is_alive():
+            break
+        if not t.is_alive():
+            break
+        time.sleep(0.5)
+    t.join(timeout=240)
+    assert not t.is_alive(), "trainer did not finish"
+    assert "train" in results, "cached trainer run died"
+    final = urllib.request.urlopen(f"{base}/metrics", timeout=10
+                                   ).read().decode()
+    exporter.stop()
+    for series in wanted:
+        assert series in final, f"missing {series} on /metrics"
+    hits = 0.0
+    for line in final.splitlines():
+        if line.startswith("cache_hit_total"):
+            hits = float(line.split()[-1])
+    assert hits > 0, "epoch 2 produced no cache hits"
+    print(f"live /metrics ok: cache_hit_total={hits:.0f}; "
+          f"loss {results['train']['loss']:.3f}")
+
+    # -- 2: bit-identical per-step digests vs the no-cache control --------
+    control: dict = {}
+    _train(ds.uri, cache_dir, str(tmp / "control.jsonl"), False, control)
+    cached_trace = read_trace(str(tmp / "cached.jsonl"))
+    control_trace = read_trace(str(tmp / "control.jsonl"))
+    assert cached_trace and len(cached_trace) == len(control_trace), (
+        len(cached_trace), len(control_trace),
+    )
+    for a, b in zip(cached_trace, control_trace):
+        assert a["batch_sha256"] == b["batch_sha256"], (
+            f"digest divergence at step {a['step']}"
+        )
+        assert abs(a["loss"] - b["loss"]) < 1e-6, (
+            f"loss divergence at step {a['step']}"
+        )
+    print(f"digest parity ok: {len(cached_trace)} steps bit-identical "
+          "across cached and control arms")
+
+    # -- 3: leak-clean teardown -------------------------------------------
+    for _ in range(50):
+        gc.collect()
+        if leaktrack.outstanding() == 0:
+            break
+        time.sleep(0.05)
+    assert leaktrack.outstanding() == 0, (
+        f"leaked leases: {leaktrack.outstanding()} outstanding "
+        f"({json.dumps({k: v for k, v in leaktrack.sites().items() if v['leaked']})})"
+    )
+    stray = [p.name for p in pathlib.Path(cache_dir).iterdir()
+             if p.suffix == ".tmp"]
+    assert not stray, f"stray spill temp files: {stray}"
+    segs = sorted(p.name for p in pathlib.Path(cache_dir).iterdir()
+                  if p.suffix == ".ldtc")
+    assert segs, "ram budget 0 must have spilled segments to disk"
+    print(f"leak sanitizer ok: 0 outstanding leases, "
+          f"{len(segs)} committed segments, no temp strays")
+    print("batch-cache smoke ok")
+
+
+if __name__ == "__main__":
+    main()

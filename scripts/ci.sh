@@ -42,6 +42,12 @@
 # trainer_transform_ms / decode_*_bytes_total series during a real
 # --device_decode train run, and zero BufferPool-lease or /dev/shm leaks
 # under LDT_LEAK_SANITIZER=1.
+# Stage 7c — batch-cache smoke (scripts/cache_smoke.py): a real two-epoch
+# --batch_cache train run asserting cache_hit_total > 0 on a live
+# /metrics scrape (epoch 2 streams hits), per-step batch digests
+# bit-identical to a --no_batch_cache control arm, zero leaked BufferPool
+# leases under the leak sanitizer, and zero stray spill temp files (every
+# disk segment committed atomically via os.replace).
 # Stage 8 — the tier-1 verify command from ROADMAP.md, verbatim — run
 # under LDT_LOCK_SANITIZER=1 AND LDT_LEAK_SANITIZER=1: every
 # threading.Lock/RLock the package creates is wrapped to record actual
@@ -157,6 +163,13 @@ echo "== device-decode smoke (entropy split, parity + live decode_* scrape) =="
 # TPU (no host callbacks — LDT101/LDT1301 pin it). Leak sanitizer on: the
 # stage fails on any stranded BufferPool lease or /dev/shm segment.
 timeout -k 10 480 env JAX_PLATFORMS=cpu LDT_LEAK_SANITIZER=1 PYTHONPATH=. python scripts/device_decode_smoke.py
+
+echo "== batch-cache smoke (epoch-2 hits, digest parity, leak-clean) =="
+# A real two-epoch --batch_cache train: cache_hit_total > 0 on a live
+# /metrics scrape during epoch 2, per-step batch digests bit-identical to
+# a --no_batch_cache control arm (LDT_STEP_TRACE_PATH), zero leaked
+# leases under LDT_LEAK_SANITIZER=1 and zero stray spill temp files.
+timeout -k 10 540 env JAX_PLATFORMS=cpu LDT_LEAK_SANITIZER=1 PYTHONPATH=. python scripts/cache_smoke.py
 
 echo "== tier-1 tests (lock + leak sanitizers on) =="
 WITNESS=/tmp/_ldt_lock_witness.json

@@ -1,0 +1,840 @@
+"""Epoch-coherent decoded-batch cache (data/cache.py, r13).
+
+The contract under test: a cache hit is BYTE-EQUAL to what decode would
+have produced — warm epochs, resumed runs, and server-side sharing are
+pure capacity moves, never content moves — and every tier obeys the
+lease/crash disciplines the analyzers pin (leases released on eviction,
+torn spills read as misses).
+"""
+
+import io
+import os
+import pathlib
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from lance_distributed_training_tpu.data import write_dataset
+from lance_distributed_training_tpu.data.buffers import BufferPool
+from lance_distributed_training_tpu.data.cache import (
+    BatchCache,
+    DeviceReplayCache,
+    PlanCache,
+    decode_fingerprint,
+    folder_fingerprint,
+    item_fingerprint,
+    plan_fingerprint,
+)
+from lance_distributed_training_tpu.data.decode import (
+    ImageClassificationDecoder,
+)
+from lance_distributed_training_tpu.data.folder import FolderDataPipeline
+from lance_distributed_training_tpu.data.pipeline import (
+    MapStylePipeline,
+    make_eval_pipeline,
+    make_train_pipeline,
+)
+from lance_distributed_training_tpu.data.samplers import ReadRange
+from lance_distributed_training_tpu.obs.registry import MetricsRegistry
+from lance_distributed_training_tpu.utils.chaos import batch_digest
+
+
+@pytest.fixture()
+def leaktrack_sandbox():
+    """Snapshot/restore the recorder around tests that enable or reset it
+    (same discipline as test_analysis.py's fixture — a sanitizer-enabled
+    tier-1 session collects its witness ACROSS the suite)."""
+    from lance_distributed_training_tpu.utils import leaktrack
+
+    saved = leaktrack.snapshot()
+    leaktrack.disable()
+    leaktrack.reset()
+    try:
+        yield leaktrack
+    finally:
+        leaktrack.restore(saved)
+
+
+def _cache(tmp_path, registry=None, pool=None, ram_mb=8, disk_mb=64,
+           name="cache"):
+    return BatchCache(
+        cache_dir=str(tmp_path / name),
+        ram_budget_mb=ram_mb,
+        disk_budget_mb=disk_mb,
+        buffer_pool=pool,
+        registry=registry if registry is not None else MetricsRegistry(),
+    )
+
+
+def _decoder(pool=None):
+    return ImageClassificationDecoder(image_size=32, buffer_pool=pool)
+
+
+def _digests(loader):
+    return [batch_digest(b) for b in loader]
+
+
+# -- fingerprints -----------------------------------------------------------
+
+
+def test_dataset_fingerprint_stable_across_reopen(image_dataset):
+    from lance_distributed_training_tpu.data import Dataset
+
+    again = Dataset(image_dataset.uri)
+    assert image_dataset.fingerprint() == again.fingerprint()
+    assert len(image_dataset.fingerprint()) == 64
+
+
+def test_dataset_fingerprint_changes_on_rewrite(tmp_path, image_table):
+    ds1 = write_dataset(image_table, tmp_path / "d", mode="create",
+                        max_rows_per_file=100)
+    fp1 = ds1.fingerprint()
+    ds2 = write_dataset(image_table.slice(0, 120), tmp_path / "d",
+                        mode="overwrite", max_rows_per_file=100)
+    assert ds2.fingerprint() != fp1
+
+
+def test_item_fingerprint_shapes():
+    rr = [ReadRange(0, 0, 16), ReadRange(1, 4, 20)]
+    assert item_fingerprint(rr) == item_fingerprint(list(rr))
+    assert item_fingerprint(rr) != item_fingerprint([ReadRange(0, 0, 17),
+                                                     ReadRange(1, 4, 20)])
+    a = np.arange(16, dtype=np.int64)
+    assert item_fingerprint(a) == item_fingerprint(a.copy())
+    assert item_fingerprint(a) != item_fingerprint(a[::-1].copy())
+    # dtype is part of the identity (an int32 gather is a different read)
+    assert item_fingerprint(a) != item_fingerprint(a.astype(np.int32))
+    ev = (a, np.ones(16, np.float32))
+    assert item_fingerprint(ev) == item_fingerprint((a.copy(),
+                                                     np.ones(16, np.float32)))
+    assert item_fingerprint(ev) != item_fingerprint(a)
+    assert item_fingerprint("not-a-plan-item") is None
+
+
+def test_decode_fingerprint_covers_decode_knobs():
+    fp32 = decode_fingerprint(_decoder())
+    fp64 = decode_fingerprint(ImageClassificationDecoder(image_size=64))
+    assert fp32 != fp64
+
+    def custom(table):  # plain-function hook falls back to qualname
+        return {}
+
+    assert "custom" in decode_fingerprint(custom)
+
+
+# -- warm-epoch bit-identity, per loader ------------------------------------
+
+
+def test_warm_epoch_bit_identity_iterable(image_dataset, tmp_path):
+    pool = BufferPool(registry=MetricsRegistry())
+    reg = MetricsRegistry()
+    cache = _cache(tmp_path, registry=reg, pool=pool)
+    dec = _decoder(pool)
+
+    def mk(c):
+        return make_train_pipeline(image_dataset, "batch", 16, 0, 1, dec,
+                                   buffer_pool=pool, batch_cache=c)
+
+    uncached = _digests(mk(None))
+    cold = _digests(mk(cache))
+    warm = _digests(mk(cache))
+    assert cold == uncached  # filling changes nothing
+    assert warm == uncached  # hits are byte-equal to decode
+    assert reg.counter("cache_hit_total").value == len(uncached)
+    cache.close()
+    pool.sweep()
+    assert pool.stats()["outstanding"] == 0
+
+
+def test_warm_epoch_hits_across_shuffled_batch_order(image_dataset, tmp_path):
+    """Iterable shuffle permutes batch ORDER only — item-content keys make
+    every later epoch a full hit despite the permutation."""
+    reg = MetricsRegistry()
+    cache = _cache(tmp_path, registry=reg)
+    dec = _decoder()
+
+    def mk(epoch):
+        return make_train_pipeline(image_dataset, "batch", 16, 0, 1, dec,
+                                   shuffle=True, seed=3, epoch=epoch,
+                                   batch_cache=cache)
+
+    _digests(mk(0))
+    misses_after_fill = reg.counter("cache_miss_total").value
+    warm = _digests(mk(1))
+    assert reg.counter("cache_miss_total").value == misses_after_fill
+    assert warm == _digests(make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1, dec, shuffle=True, seed=3, epoch=1,
+    ))
+    cache.close()
+
+
+def test_warm_epoch_bit_identity_map_style(image_dataset, tmp_path):
+    cache = _cache(tmp_path)
+    dec = _decoder()
+
+    def mk(c):
+        return MapStylePipeline(image_dataset, 16, 0, 1, dec, shuffle=False,
+                                batch_cache=c)
+
+    uncached = _digests(mk(None))
+    assert _digests(mk(cache)) == uncached
+    assert _digests(mk(cache)) == uncached
+    cache.close()
+
+
+def test_map_style_reshuffle_misses_honestly(image_dataset, tmp_path):
+    """Map-style epochs reshuffle at ROW level: epoch 1's batches are new
+    content, so they must MISS (not alias epoch 0 entries) and match the
+    uncached stream bit-for-bit."""
+    cache = _cache(tmp_path, ram_mb=64)
+    dec = _decoder()
+    pipe = MapStylePipeline(image_dataset, 16, 0, 1, dec, shuffle=True,
+                            seed=1, batch_cache=cache)
+    _ = _digests(pipe)
+    pipe.set_epoch(1)
+    got = _digests(pipe)
+    ref_pipe = MapStylePipeline(image_dataset, 16, 0, 1, dec, shuffle=True,
+                                seed=1)
+    ref_pipe.set_epoch(1)
+    assert got == _digests(ref_pipe)
+    cache.close()
+
+
+def test_warm_epoch_bit_identity_folder(tmp_path):
+    from lance_distributed_training_tpu.data.authoring import (
+        create_synthetic_image_folder,
+    )
+
+    root = create_synthetic_image_folder(
+        tmp_path / "folder", rows=64, num_classes=4, image_size=32, seed=5,
+    )
+    cache = _cache(tmp_path)
+    dec = _decoder()
+
+    def mk(c, style):
+        return FolderDataPipeline(str(root), 16, 0, 1, dec,
+                                  loader_style=style, shuffle=False,
+                                  batch_cache=c)
+
+    for style in ("iterable", "map"):
+        uncached = _digests(mk(None, style))
+        assert _digests(mk(cache, style)) == uncached
+        assert _digests(mk(cache, style)) == uncached
+    cache.close()
+
+
+def test_folder_fingerprint_computed_once(tmp_path, monkeypatch):
+    """The r13 satellite: the corpus fingerprint is hashed ONCE at
+    construction and reused by every epoch's plan-cache binding."""
+    from lance_distributed_training_tpu.data import cache as cache_mod
+    from lance_distributed_training_tpu.data import folder as folder_mod
+    from lance_distributed_training_tpu.data.authoring import (
+        create_synthetic_image_folder,
+    )
+
+    root = create_synthetic_image_folder(
+        tmp_path / "folder", rows=32, num_classes=2, image_size=32, seed=6,
+    )
+    calls = {"n": 0}
+    original = cache_mod.folder_fingerprint
+
+    def counting(samples):
+        calls["n"] += 1
+        return original(samples)
+
+    monkeypatch.setattr(cache_mod, "folder_fingerprint", counting)
+    # Cacheless pipelines never pay the full-tree stat+hash at all.
+    bare = FolderDataPipeline(str(root), 16, 0, 1, _decoder())
+    for _ in bare:
+        pass
+    assert calls["n"] == 0
+    pipe = FolderDataPipeline(str(root), 16, 0, 1, _decoder(),
+                              batch_cache=_cache(tmp_path))
+    assert calls["n"] == 0  # lazy: nothing hashed until a cache key is cut
+    for epoch in (0, 1, 2):
+        pipe.set_epoch(epoch)
+        for _ in pipe:
+            pass
+    assert calls["n"] == 1  # hashed once, reused by every epoch's binding
+    assert pipe.dataset_fingerprint == original(pipe.samples)
+    pipe.batch_cache.close()
+
+
+def test_folder_fingerprint_tracks_file_content(tmp_path):
+    """A corpus regenerated in place (same filenames/labels, new bytes)
+    must change identity — the restart-persistent disk tier can never
+    serve the old pixels."""
+    from lance_distributed_training_tpu.data.authoring import (
+        create_synthetic_image_folder,
+    )
+
+    root = create_synthetic_image_folder(
+        tmp_path / "folder", rows=8, num_classes=2, image_size=32, seed=6,
+    )
+    pipe = FolderDataPipeline(str(root), 4, 0, 1, _decoder())
+    fp1 = pipe.dataset_fingerprint
+    jpgs = sorted(pathlib.Path(root).rglob("*.jpg"))
+    with open(jpgs[0], "ab") as f:  # same name, different bytes/size
+        f.write(b"\x00" * 16)
+    pipe2 = FolderDataPipeline(str(root), 4, 0, 1, _decoder())
+    assert pipe2.dataset_fingerprint != fp1
+
+
+def test_dataset_fingerprint_tracks_fragment_bytes(tmp_path, image_table):
+    """An in-place regenerate that keeps version/names/row counts but
+    changes fragment bytes still changes the fingerprint (size rides it)."""
+    from lance_distributed_training_tpu.data import Dataset
+
+    ds = write_dataset(image_table, tmp_path / "d", mode="create",
+                       max_rows_per_file=100)
+    fp1 = ds.fingerprint()
+    frag = ds.fragments[0].path
+    with open(frag, "ab") as f:
+        f.write(b"\x00" * 64)
+    assert Dataset(tmp_path / "d").fingerprint() != fp1
+
+
+def test_plan_fp_callable_rescopes_live_knob_moves(tmp_path):
+    """A callable plan_fp is evaluated per key: moving a live decode knob
+    mid-epoch moves later entries to a NEW scope instead of aliasing
+    differently-shaped bytes under the old one."""
+    cache = _cache(tmp_path, ram_mb=64)
+    knob = {"v": 1}
+    pc = PlanCache(cache, "ds", lambda: plan_fingerprint(decode=knob["v"]))
+    item = np.arange(4, dtype=np.int64)
+    assert pc.put(item, {"x": np.full(4, 1, np.int32)})
+    knob["v"] = 2  # the actuation
+    assert pc.get(item) is None  # old-scope entry no longer visible
+    assert pc.put(item, {"x": np.full(4, 2, np.int32)})
+    np.testing.assert_array_equal(pc.get(item)["x"], np.full(4, 2, np.int32))
+    knob["v"] = 1  # revert: the original scope's bytes come back intact
+    np.testing.assert_array_equal(pc.get(item)["x"], np.full(4, 1, np.int32))
+    cache.close()
+
+
+def test_sibling_eviction_is_a_miss_not_torn(tmp_path):
+    """A segment deleted out from under this index (a sibling process's
+    budget eviction) is a plain miss — cache_torn_total is reserved for
+    real corruption."""
+    reg = MetricsRegistry()
+    cache = _cache(tmp_path, registry=reg, ram_mb=0)
+    key = ("d", "p", 0, "i")
+    assert cache.put(key, {"x": np.zeros(8, np.uint8)})
+    seg = next(p for p in (tmp_path / "cache").iterdir()
+               if p.suffix == ".ldtc")
+    seg.unlink()  # the sibling's eviction
+    assert cache.get(key) is None
+    assert reg.counter("cache_torn_total").value == 0
+    assert reg.counter("cache_miss_total").value == 1
+    assert cache.stats()["disk_entries"] == 0  # index dropped the corpse
+    cache.close()
+
+
+def test_store_counter_counts_only_admissions(tmp_path):
+    """cache_store_total means FILLS: a declined oversized spill (disk
+    budget 0) must not count."""
+    reg = MetricsRegistry()
+    cache = _cache(tmp_path, registry=reg, ram_mb=1, disk_mb=0)
+    big = {"x": np.zeros((2 << 20,), np.uint8)}  # > ram ring, disk off
+    assert cache.put(("d", "p", 0, "big"), big) is False
+    assert reg.counter("cache_store_total").value == 0
+    assert cache.put(("d", "p", 0, "s"), {"x": np.zeros(8, np.uint8)})
+    assert reg.counter("cache_store_total").value == 1
+    cache.close()
+
+
+def test_disk_promote_adopts_without_pool_lease(image_dataset, tmp_path):
+    """Disk-hit promotion adopts the loaded arrays (no third memcpy, no
+    pool lease) — and the adopted entries still release cleanly (close
+    leaves zero outstanding pool pages, leaktrack balanced)."""
+    pool = BufferPool(registry=MetricsRegistry())
+    cache = _cache(tmp_path, pool=pool, ram_mb=0)
+    dec = _decoder(pool)
+    control = _digests(make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1, dec, buffer_pool=pool,
+        batch_cache=cache,
+    ))
+    cache.set_ram_budget_mb(8)  # allow promotion now
+    warm = _digests(make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1, dec, buffer_pool=pool,
+        batch_cache=cache,
+    ))
+    assert warm == control
+    assert cache.stats()["ram_entries"] == len(control)  # promoted
+    cache.close()
+    pool.sweep()
+    assert pool.stats()["outstanding"] == 0
+
+
+def test_scan_sweeps_orphan_tmp_files(tmp_path):
+    """A SIGKILL between mkstemp and os.replace leaves a .tmp orphan; the
+    next process's scan removes it (it sits outside budget accounting)."""
+    cache = _cache(tmp_path, ram_mb=0)
+    assert cache.put(("d", "p", 0, "i"), {"x": np.zeros(8, np.uint8)})
+    cache.close()
+    orphan = tmp_path / "cache" / "deadbeef.tmp"
+    orphan.write_bytes(b"torn half-spill")
+    cache2 = BatchCache(cache_dir=str(tmp_path / "cache"), ram_budget_mb=0,
+                        disk_budget_mb=64, registry=MetricsRegistry())
+    assert not orphan.exists()
+    assert cache2.stats()["disk_entries"] == 1  # the real segment survived
+    cache2.close()
+
+
+def test_warm_epoch_bit_identity_workers(image_dataset, tmp_path):
+    """Worker-pool path: the probe/miss-list discipline — imap decodes
+    only the misses, hits come from the cache, plan order intact."""
+    from lance_distributed_training_tpu.data.workers import (
+        WorkerPool,
+        columnar_spec,
+    )
+
+    pool = BufferPool(registry=MetricsRegistry())
+    reg = MetricsRegistry()
+    dec = _decoder(pool)
+    cache = _cache(tmp_path, registry=reg, pool=pool)
+    wp = WorkerPool(columnar_spec(image_dataset.uri), dec, 2,
+                    columns=["image", "label"], buffer_pool=pool)
+    try:
+        def mk(c):
+            return make_train_pipeline(image_dataset, "batch", 16, 0, 1, dec,
+                                       workers=wp, buffer_pool=pool,
+                                       batch_cache=c)
+
+        uncached = _digests(mk(None))
+        assert _digests(mk(cache)) == uncached
+        # Probed misses route around get() but must still COUNT as
+        # misses — a cold cache under workers is 0% hit rate, not 100%.
+        assert reg.counter("cache_miss_total").value == len(uncached)
+        assert reg.counter("cache_hit_total").value == 0
+        assert _digests(mk(cache)) == uncached
+        assert reg.counter("cache_hit_total").value == len(uncached)
+    finally:
+        wp.shutdown()
+        cache.close()
+
+
+def test_warm_epoch_bit_identity_eval(image_dataset, tmp_path):
+    cache = _cache(tmp_path)
+    dec = _decoder()
+
+    def mk(c):
+        return make_eval_pipeline(
+            lambda idx: image_dataset.take(idx, columns=["image", "label"]),
+            image_dataset.count_rows(), 32, 0, 1, dec,
+            batch_cache=c, dataset_fingerprint=image_dataset.fingerprint(),
+        )
+
+    uncached = _digests(mk(None))
+    assert _digests(mk(cache)) == uncached
+    assert _digests(mk(cache)) == uncached
+    cache.close()
+
+
+def test_warm_epoch_bit_identity_remote(image_dataset, tmp_path):
+    """Server-side cache: RemoteLoader inherits hits (second connection =
+    second client/epoch) with a byte-identical stream."""
+    from lance_distributed_training_tpu.service import (
+        DataService,
+        RemoteLoader,
+        ServeConfig,
+    )
+
+    svc = DataService(ServeConfig(
+        dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+        image_size=32, batch_cache=True,
+        cache_dir=str(tmp_path / "svc-cache"),
+    )).start()
+    try:
+        local = _digests(make_train_pipeline(
+            image_dataset, "batch", 16, 0, 1, _decoder(),
+        ))
+
+        def remote():
+            return RemoteLoader(
+                f"127.0.0.1:{svc.port}", 16, 0, 1, image_size=32,
+                dataset_fingerprint=image_dataset.fingerprint(),
+                connect_retries=2, backoff_s=0.01,
+            )
+
+        assert _digests(remote()) == local
+        stats = svc.batch_cache.stats()
+        assert stats["ram_entries"] + stats["disk_entries"] > 0
+        assert _digests(remote()) == local  # second client: pure hits
+    finally:
+        svc.stop()
+    assert svc.batch_cache.stats()["ram_entries"] == 0  # stop released
+
+
+def test_warm_epoch_bit_identity_fleet(image_dataset, tmp_path):
+    """Both fleet members run the cache; the striped+merged stream stays
+    bit-identical across a cold and a warm pass."""
+    from lance_distributed_training_tpu.fleet.balancer import FleetLoader
+    from lance_distributed_training_tpu.fleet.coordinator import (
+        Coordinator,
+        CoordinatorConfig,
+    )
+    from lance_distributed_training_tpu.service import (
+        DataService,
+        ServeConfig,
+    )
+
+    coord = Coordinator(CoordinatorConfig(
+        host="127.0.0.1", port=0,
+        heartbeat_interval_s=0.1, lease_ttl_s=0.6,
+    )).start()
+    servers = []
+    try:
+        for i in range(2):
+            svc = DataService(ServeConfig(
+                dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+                image_size=32, queue_depth=2, batch_cache=True,
+                cache_dir=str(tmp_path / f"member{i}-cache"),
+                coordinator_addr=f"127.0.0.1:{coord.port}",
+            )).start()
+            assert svc.fleet_agent.registered.wait(5)
+            servers.append(svc)
+        local = _digests(make_train_pipeline(
+            image_dataset, "batch", 16, 0, 1, _decoder(),
+        ))
+
+        def fleet_loader():
+            return FleetLoader(
+                f"127.0.0.1:{coord.port}", 16, 0, 1, image_size=32,
+                dataset_fingerprint=image_dataset.fingerprint(),
+                connect_retries=2, resolve_retries=3, backoff_s=0.05,
+            )
+
+        assert _digests(fleet_loader()) == local
+        assert _digests(fleet_loader()) == local
+        assert any(
+            s.batch_cache.stats()["ram_entries"]
+            + s.batch_cache.stats()["disk_entries"] > 0
+            for s in servers
+        )
+    finally:
+        for s in servers:
+            s.stop()
+        coord.stop()
+
+
+def test_device_decode_coeff_pages_warm_identity(image_dataset, tmp_path):
+    """The coefficient-page arm: warm epochs replay bit-identical PAGES
+    (full-epoch replay with fixed knobs — the envelope the module
+    docstring documents)."""
+    from lance_distributed_training_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("native coefficient extractor unavailable")
+    from lance_distributed_training_tpu.data.device_decode import (
+        CoeffImageDecoder,
+    )
+
+    dec = CoeffImageDecoder(image_size=32)
+    cache = _cache(tmp_path, ram_mb=32)
+
+    def mk(c):
+        return make_train_pipeline(image_dataset, "batch", 16, 0, 1,
+                                   CoeffImageDecoder(image_size=32),
+                                   batch_cache=c)
+
+    uncached = _digests(mk(None))
+    assert _digests(mk(cache)) == uncached
+    assert _digests(mk(cache)) == uncached
+    # chunk granularity is part of the key space: a different chunk must
+    # not alias the cached pages
+    fp4 = decode_fingerprint(dec)
+    dec.set_chunk(8)
+    assert decode_fingerprint(dec) != fp4
+    cache.close()
+
+
+# -- resume + crash shapes ---------------------------------------------------
+
+
+def test_mid_epoch_resume_with_warm_cache_bit_identical(image_dataset,
+                                                        tmp_path):
+    """The SIGKILL+restart shape at the loader level: consume k batches,
+    abandon, rebuild at the cursor with the (partially or fully) warm
+    cache — the resumed tail must equal the uninterrupted run's."""
+    cache = _cache(tmp_path)
+    dec = _decoder()
+
+    def mk():
+        return make_train_pipeline(image_dataset, "batch", 16, 0, 1, dec,
+                                   batch_cache=cache)
+
+    control = _digests(mk())  # also fills the cache (epoch 1)
+    # "Killed" run: consume 5 then abandon mid-epoch.
+    loader = mk()
+    it = iter(loader)
+    got = [batch_digest(next(it)) for _ in range(5)]
+    cursor = loader.state_dict()
+    it.close()
+    assert cursor["step"] == 5
+    # Restarted run: rebuilt loader, positioned at the cursor, cache warm.
+    resumed = mk()
+    resumed.load_state_dict(cursor)
+    got += _digests(resumed)
+    assert got == control
+    cache.close()
+
+
+def test_torn_spill_reads_as_miss(image_dataset, tmp_path):
+    """Every torn-segment shape — truncation, corrupt magic, a flipped
+    payload byte — must read as a MISS that falls back to decode with an
+    unchanged stream, never as corrupt content."""
+    reg = MetricsRegistry()
+    dec = _decoder()
+
+    def mk(c):
+        return make_train_pipeline(image_dataset, "batch", 16, 0, 1, dec,
+                                   batch_cache=c)
+
+    # ram_mb=0: every entry spills, so the warm path is all-disk.
+    cache = _cache(tmp_path, registry=reg, ram_mb=0)
+    control = _digests(mk(cache))
+    segs = sorted(
+        p for p in (tmp_path / "cache").iterdir() if p.suffix == ".ldtc"
+    )
+    assert len(segs) == len(control)
+    with open(segs[0], "r+b") as f:  # corrupt the magic
+        f.write(b"XXXXXXXX")
+    with open(segs[1], "r+b") as f:  # flip one payload byte
+        f.seek(-1, os.SEEK_END)
+        last = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([last[0] ^ 0xFF]))
+    with open(segs[2], "r+b") as f:  # truncate mid-payload
+        f.truncate(64)
+    reg2 = MetricsRegistry()
+    cache2 = BatchCache(cache_dir=str(tmp_path / "cache"), ram_budget_mb=0,
+                        disk_budget_mb=64, registry=reg2)
+    assert _digests(mk(cache2)) == control
+    assert reg2.counter("cache_torn_total").value == 3
+    assert reg2.counter("cache_miss_total").value == 3
+    # the torn files were retired and refilled by the re-decode
+    cache2.close()
+    cache.close()
+
+
+def test_disk_restart_warm_skips_decode(image_dataset, tmp_path):
+    """A NEW process (new BatchCache over the same dir) serves from the
+    disk tier: zero decode calls on the warm epoch."""
+    calls = {"n": 0}
+    inner = _decoder()
+
+    def counting(table):
+        calls["n"] += 1
+        return inner(table)
+
+    counting.cache_fingerprint = inner.cache_fingerprint
+
+    def mk(c):
+        return make_train_pipeline(image_dataset, "batch", 16, 0, 1,
+                                   counting, batch_cache=c)
+
+    cache = _cache(tmp_path, ram_mb=0)  # all entries on disk
+    control = _digests(mk(cache))
+    cache.close()
+    decoded_cold = calls["n"]
+    assert decoded_cold == len(control)
+    cache2 = BatchCache(cache_dir=str(tmp_path / "cache"), ram_budget_mb=8,
+                        disk_budget_mb=64, registry=MetricsRegistry())
+    assert _digests(mk(cache2)) == control
+    assert calls["n"] == decoded_cold  # not one extra decode
+    cache2.close()
+
+
+# -- budgets, eviction, leases ----------------------------------------------
+
+
+def test_shrinking_ram_budget_releases_leases(image_dataset, tmp_path,
+                                              leaktrack_sandbox):
+    """The eviction edge under LDT_LEAK_SANITIZER: shrinking
+    cache_ram_budget_mb spills and releases every page lease — zero
+    outstanding pool pages and zero leaked cache-entry handles."""
+    leaktrack = leaktrack_sandbox
+    leaktrack.enable()
+    pool = BufferPool(registry=MetricsRegistry())
+    cache = _cache(tmp_path, pool=pool)
+    dec = _decoder(pool)
+    control = _digests(make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1, dec, buffer_pool=pool,
+        batch_cache=cache,
+    ))
+    assert cache.stats()["ram_entries"] == len(control)
+    cache.set_ram_budget_mb(0)
+    st = cache.stats()
+    assert st["ram_entries"] == 0
+    assert st["disk_entries"] == len(control)  # evictions spilled first
+    pool.sweep()
+    assert pool.stats()["outstanding"] == 0
+    leaked = {
+        site: entry["leaked"]
+        for site, entry in leaktrack.sites().items()
+        if entry["leaked"]
+    }
+    assert not leaked, leaked
+    # warm epoch survives the eviction, now all-disk
+    assert _digests(make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1, dec, buffer_pool=pool,
+        batch_cache=cache,
+    )) == control
+    cache.close()
+
+
+def test_tunable_bounds_and_clamp(tmp_path):
+    cache = _cache(tmp_path)
+    knobs = {t.name: t for t in cache.tunables()}
+    assert set(knobs) == {"cache_ram_budget_mb", "cache_disk_budget_mb"}
+    for t in knobs.values():
+        assert t.lo < t.hi  # LDT1101's invariant, live
+    assert knobs["cache_ram_budget_mb"].set(-5) == knobs[
+        "cache_ram_budget_mb"
+    ].lo
+    assert knobs["cache_ram_budget_mb"].set(10**9) == knobs[
+        "cache_ram_budget_mb"
+    ].hi
+    cache.close()
+
+
+def test_disk_budget_evicts_oldest(tmp_path):
+    cache = _cache(tmp_path, ram_mb=0, disk_mb=64)  # ram 0: all to disk
+    for i in range(6):  # 6 x ~2 MiB segments
+        assert cache.put(("d", "p", 0, f"i{i}"),
+                         {"x": np.full((2 << 20,), i, np.uint8)})
+    assert cache.stats()["disk_entries"] == 6
+    cache.set_disk_budget_mb(5)  # room for two 2-MiB entries
+    st = cache.stats()
+    assert st["disk_entries"] == 2
+    assert st["disk_bytes"] <= 5 << 20
+    # the OLDEST were evicted: 0..3 gone, 4 and 5 survive
+    assert cache.get(("d", "p", 0, "i0")) is None
+    np.testing.assert_array_equal(
+        cache.get(("d", "p", 0, "i5"))["x"][:4], np.full(4, 5, np.uint8)
+    )
+    cache.close()
+
+
+def test_put_declines_non_arrays_and_duplicates(tmp_path):
+    cache = _cache(tmp_path)
+    key = ("d", "p", 0, "i")
+    batch = {"x": np.arange(8, dtype=np.int32)}
+    assert cache.put(key, batch) is True
+    assert cache.put(key, batch) is False  # duplicate
+    assert cache.put(("d", "p", 0, "j"), {"x": "not-an-array"}) is False
+    assert cache.put(("d", "p", 0, "k"), {}) is False
+    got = cache.get(key)
+    np.testing.assert_array_equal(got["x"], batch["x"])
+    # the returned copy is the CALLER's: mutating it can't poison the ring
+    got["x"][:] = 0
+    np.testing.assert_array_equal(cache.get(key)["x"], batch["x"])
+    cache.close()
+
+
+def test_oversized_entry_goes_straight_to_disk(tmp_path):
+    reg = MetricsRegistry()
+    cache = _cache(tmp_path, registry=reg, ram_mb=1, disk_mb=64)
+    big = {"x": np.zeros((2 << 20,), np.uint8)}  # 2 MiB > 1 MiB ring
+    assert cache.put(("d", "p", 0, "big"), big) is True
+    st = cache.stats()
+    assert st["ram_entries"] == 0 and st["disk_entries"] == 1
+    got = cache.get(("d", "p", 0, "big"))
+    np.testing.assert_array_equal(got["x"], big["x"])
+    cache.close()
+
+
+def test_plan_scopes_are_disjoint(image_dataset, tmp_path):
+    """Different decode configs (and eval vs train) never alias entries
+    over the same rows."""
+    cache = _cache(tmp_path, ram_mb=64)
+    a = PlanCache(cache, image_dataset.fingerprint(),
+                  plan_fingerprint(decode="A"))
+    b = PlanCache(cache, image_dataset.fingerprint(),
+                  plan_fingerprint(decode="B"))
+    item = np.arange(4, dtype=np.int64)
+    assert a.put(item, {"x": np.ones(4, np.float32)})
+    assert a.contains(item)
+    assert not b.contains(item)
+    assert b.get(item) is None
+    cache.close()
+
+
+# -- HELLO fingerprint skew (the satellite's wire half) ----------------------
+
+
+def test_hello_dataset_fingerprint_skew(image_dataset):
+    from lance_distributed_training_tpu.service import (
+        DataService,
+        RemoteLoader,
+        ServeConfig,
+    )
+    from lance_distributed_training_tpu.service.protocol import ProtocolError
+
+    svc = DataService(ServeConfig(
+        dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+        image_size=32,
+    )).start()
+    try:
+        # matching fingerprint: accepted
+        ok = RemoteLoader(f"127.0.0.1:{svc.port}", 16, 0, 1, image_size=32,
+                          dataset_fingerprint=image_dataset.fingerprint(),
+                          connect_retries=2, backoff_s=0.01)
+        assert len(ok) > 0
+        # undeclared (old client / no local mount): skipped
+        legacy = RemoteLoader(f"127.0.0.1:{svc.port}", 16, 0, 1,
+                              image_size=32,
+                              connect_retries=2, backoff_s=0.01)
+        assert len(legacy) == len(ok)
+        # mismatch: rejected at connect, loudly
+        with pytest.raises(ProtocolError, match="dataset skew"):
+            len(RemoteLoader(f"127.0.0.1:{svc.port}", 16, 0, 1,
+                             image_size=32, dataset_fingerprint="deadbeef",
+                             connect_retries=1, backoff_s=0.01))
+    finally:
+        svc.stop()
+
+
+# -- the HBM replay tier (DeviceReplayCache) ---------------------------------
+
+
+def test_device_replay_cache_fill_then_replay():
+    reg = MetricsRegistry()
+    c = DeviceReplayCache(enabled=True, budget_gb=8.0, seed=0, registry=reg)
+    assert c.replay_iter(0, 0, shuffled=False) is None  # first epoch streams
+    assert c.start_fill(replaying=False, resume_step=0) is True
+    batches = [{"x": np.full((4,), i, np.int32)} for i in range(5)]
+    for b in batches:
+        assert c.admit(b, total_steps=5) is None
+    got = list(c.replay_iter(1, 0, shuffled=False))
+    assert [int(b["x"][0]) for b in got] == [0, 1, 2, 3, 4]
+    # shuffled replay: a seeded batch-order permutation, distinct per epoch
+    o1 = [int(b["x"][0]) for b in c.replay_iter(1, 0, shuffled=True)]
+    o2 = [int(b["x"][0]) for b in c.replay_iter(2, 0, shuffled=True)]
+    assert sorted(o1) == sorted(o2) == [0, 1, 2, 3, 4]
+    assert o1 == [int(b["x"][0])
+                  for b in c.replay_iter(1, 0, shuffled=True)]  # seeded
+    assert reg.gauge("cache_device_batches").value == 5
+
+
+def test_device_replay_cache_partial_epoch_exclusion():
+    c = DeviceReplayCache(enabled=True, budget_gb=8.0, seed=0,
+                          registry=MetricsRegistry())
+    # resumed mid-epoch: must NOT seed the replay set
+    assert c.start_fill(replaying=False, resume_step=3) is False
+    assert c.admit({"x": np.zeros(4)}, 5) is None
+    assert len(c) == 0
+    assert c.replay_iter(1, 0, shuffled=False) is None
+
+
+def test_device_replay_cache_budget_guard():
+    c = DeviceReplayCache(enabled=True, budget_gb=1e-9, seed=0,
+                          registry=MetricsRegistry())
+    assert c.start_fill(replaying=False, resume_step=0) is True
+    refused = c.admit({"x": np.zeros((1024, 1024), np.uint8)}, 100)
+    assert refused is not None
+    assert refused["projected"] > refused["budget"]
+    assert not c.enabled and len(c) == 0
+    assert c.admit({"x": np.zeros(4)}, 100) is None  # disabled: no-op

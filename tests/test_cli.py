@@ -84,6 +84,32 @@ def test_cli_optimizer_and_cache_flags(monkeypatch):
     assert config.device_cache_gb == 2.5
 
 
+def test_cli_batch_cache_flags(monkeypatch):
+    """The r13 batch-cache knobs reach TrainConfig; --no_batch_cache (and
+    the bare default) keep the exact uncached control arm."""
+    captured = {}
+    monkeypatch.setattr(
+        cli, "train", lambda config: captured.update(config=config) or {}
+    )
+    cli.main([
+        "--dataset_path", "/d", "--no_wandb", "--batch_cache",
+        "--cache_ram_budget_mb", "64", "--cache_disk_budget_mb", "256",
+        "--cache_dir", "/tmp/bc",
+    ])
+    config = captured["config"]
+    assert config.batch_cache is True
+    assert config.cache_ram_budget_mb == 64
+    assert config.cache_disk_budget_mb == 256
+    assert config.cache_dir == "/tmp/bc"
+    cli.main(["--dataset_path", "/d", "--no_wandb"])
+    assert captured["config"].batch_cache is False  # default = control arm
+    cli.main(["--dataset_path", "/d", "--no_wandb", "--no_batch_cache"])
+    assert captured["config"].batch_cache is False
+    with pytest.raises(SystemExit):  # mutually exclusive
+        cli.main(["--dataset_path", "/d", "--batch_cache",
+                  "--no_batch_cache"])
+
+
 def test_cli_data_and_eval_flags(monkeypatch):
     captured = {}
     monkeypatch.setattr(
