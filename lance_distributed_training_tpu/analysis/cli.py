@@ -73,6 +73,14 @@ def build_check_parser() -> argparse.ArgumentParser:
                         "utils/leaktrack.py): sites that demonstrably "
                         "leaked corroborate LDT1201 findings, exercised-"
                         "and-balanced sites mark them witness_pruned")
+    p.add_argument("--wire-witness", default=None, metavar="PATH",
+                   help="runtime wire-traffic witness JSON (emitted by a "
+                        "test run under LDT_WIRE_SANITIZER=1, "
+                        "utils/wiretrack.py): a (msg, field) tuple "
+                        "observed crossing the wire prunes the LDT1403 "
+                        "orphan-read at that field (a writer exists "
+                        "outside the static view); a message exercised "
+                        "without the field corroborates it")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule registry and exit")
     return p
@@ -105,6 +113,32 @@ def load_lock_witness(path: str, root: str) -> dict:
         for site, count in data.get("acquired", {}).items()
     }
     return {"edges": edges, "acquired": acquired}
+
+
+def load_wire_witness(path: str) -> dict:
+    """Parse a ``utils/wiretrack.py`` witness file into the structure the
+    LDT1403 rule consumes: ``{"frames": {msg_value: count}, "fields":
+    {msg_value: {field: count}}, "versions": {msg_value: [v, ...]}}``.
+    Message types are numeric on the wire — the protocol model maps them
+    back to ``MSG_*`` names, so every key must parse as an int HERE
+    (``str(int(k))`` normalizes and raises into the caller's
+    unreadable-witness exit-2 path, never a mid-analysis traceback)."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {
+        "frames": {
+            str(int(k)): int(v)
+            for k, v in data.get("frames", {}).items()
+        },
+        "fields": {
+            str(int(k)): {str(field): int(n) for field, n in fields.items()}
+            for k, fields in data.get("fields", {}).items()
+        },
+        "versions": {
+            str(int(k)): sorted(int(v) for v in versions)
+            for k, versions in data.get("versions", {}).items()
+        },
+    }
 
 
 def load_leak_witness(path: str, root: str) -> dict:
@@ -165,6 +199,16 @@ def check_main(argv: Optional[Sequence[str]] = None,
             out.write(
                 f"ldt check: unreadable leak witness "
                 f"{args.leak_witness}: {exc}\n"
+            )
+            return 2
+    if args.wire_witness:
+        try:
+            config.wire_witness = load_wire_witness(args.wire_witness)
+        except (OSError, ValueError, KeyError, TypeError,
+                AttributeError) as exc:
+            out.write(
+                f"ldt check: unreadable wire witness "
+                f"{args.wire_witness}: {exc}\n"
             )
             return 2
 
@@ -231,6 +275,23 @@ def check_main(argv: Optional[Sequence[str]] = None,
                 f"{summary['runtime_sites']} runtime sites match static "
                 f"acquire sites, {summary['leaked_sites']} leaked\n"
             )
+        wire_summary = timing.get("wire_witness")
+        if wire_summary is not None:
+            # Same receipt discipline for the wire witness: observed
+            # (msg, field) traffic mapped onto the static payload schema.
+            versions = wire_summary.get("versions_seen") or []
+            suffix = (
+                " (versions seen: "
+                + ", ".join(str(v) for v in versions) + ")"
+                if versions else ""
+            )
+            out.write(
+                f"ldt check: wire witness: "
+                f"{wire_summary['matched_fields']}/"
+                f"{wire_summary['observed_fields']} observed (msg, field) "
+                f"tuples match the static schema over "
+                f"{wire_summary['frames']} frames{suffix}\n"
+            )
     return 1 if any(not f.witness_pruned for f in new) else 0
 
 
@@ -256,6 +317,11 @@ def build_graph_parser() -> argparse.ArgumentParser:
                         "thread boxes and lock ellipses, acquire->release "
                         "edges, RED acquire edges for leak-on-path "
                         "findings")
+    p.add_argument("--protocol", action="store_true",
+                   help="also render the wire-protocol model: MSG_* "
+                        "hexagons with writer->msg->reader edges, "
+                        "per-message field schemas, and the version-gate "
+                        "annotations LDT1402 enforces")
     return p
 
 
@@ -290,6 +356,11 @@ def graph_main(argv: Optional[Sequence[str]] = None, out=None) -> int:
         from .ownermodel import build_owner_model
 
         owner = build_owner_model(program, config)
+    proto = None
+    if args.protocol:
+        from .protomodel import build_proto_model
+
+        proto = build_proto_model(program, config)
 
     # thread root -> set of lock keys any function on that root acquires
     root_locks: dict = {}
@@ -373,6 +444,48 @@ def graph_main(argv: Optional[Sequence[str]] = None, out=None) -> int:
                         f'[color="#16a34a", '
                         f'label="{rec.module}:{rec.line}"];\n'
                     )
+        if proto is not None:
+            # Message hexagons between their writers and readers: the
+            # per-field schema rides the node label, gated fields marked.
+            for name in sorted(proto.messages):
+                info = proto.messages[name]
+                if name in proto.binary_messages:
+                    label = f"{name}\\n(binary)"
+                else:
+                    fields = sorted(set(info.writes) | set(info.reads))
+                    marked = [
+                        f + "*" if (
+                            f"{name}.{f}" in proto.gated_fields
+                            or f in proto.gated_fields
+                        ) else f
+                        for f in fields
+                    ]
+                    label = name + (
+                        "\\n" + ", ".join(marked) if marked else ""
+                    )
+                out.write(
+                    f'  "msg:{name}" [label="{label}", shape=hexagon, '
+                    'style=filled, fillcolor="#ede9fe"];\n'
+                )
+                writers = sorted({
+                    s.func for sites in info.writes.values()
+                    for s in sites
+                })
+                readers = sorted({
+                    s.func for sites in info.reads.values() for s in sites
+                })
+                for w in writers:
+                    out.write(
+                        f'  "fn:{w}" [label="{_short(w)}", shape=box];\n'
+                        f'  "fn:{w}" -> "msg:{name}" '
+                        '[color="#7c3aed"];\n'
+                    )
+                for r in readers:
+                    out.write(
+                        f'  "fn:{r}" [label="{_short(r)}", shape=box];\n'
+                        f'  "msg:{name}" -> "fn:{r}" '
+                        '[color="#2563eb"];\n'
+                    )
         out.write("}\n")
     else:
         out.write(f"concurrency model over {files_checked} files: "
@@ -410,6 +523,37 @@ def graph_main(argv: Optional[Sequence[str]] = None, out=None) -> int:
                     f"  resource {rec.kind} acquired in "
                     f"{_short(rec.func)} ({rec.module}:{rec.line}){tag}\n"
                 )
+        if proto is not None:
+            n_fields = sum(
+                len(set(i.writes) | set(i.reads))
+                for i in proto.messages.values()
+            )
+            out.write(
+                f"  protocol model: {len(proto.messages)} messages, "
+                f"{n_fields} payload fields, "
+                f"{len(proto.gate_constants)} version gates\n"
+            )
+            for name in sorted(proto.messages):
+                info = proto.messages[name]
+                if name in proto.binary_messages:
+                    out.write(f"  msg {name}: binary payload\n")
+                    continue
+                fields = sorted(set(info.writes) | set(info.reads))
+                if not fields:
+                    continue
+                parts = []
+                for f in fields:
+                    mark = ""
+                    if f not in info.reads:
+                        mark = "!w-only"  # written, no peer read (LDT1401)
+                    elif f not in info.writes:
+                        mark = "!r-only"  # read, no writer (LDT1403)
+                    gate = proto.gated_fields.get(f"{name}.{f}") \
+                        or proto.gated_fields.get(f)
+                    if gate:
+                        mark += f" >={gate}"
+                    parts.append(f + (f" [{mark.strip()}]" if mark else ""))
+                out.write(f"  msg {name}: {', '.join(parts)}\n")
     return 0
 
 

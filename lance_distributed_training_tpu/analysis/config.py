@@ -42,8 +42,32 @@ class CheckConfig:
             "lance_distributed_training_tpu/data/workers.py",
         ]
     )
-    # LDT501: the protocol-constant source of truth.
+    # LDT501: the protocol-constant source of truth. Also the one module
+    # allowed to own raw byte-framing (LDT1404) and the schema owner whose
+    # internal reads never satisfy the peer-read contract (LDT1401).
     protocol_module: str = "lance_distributed_training_tpu/service/protocol.py"
+    # LDT1402: version-gated payload fields — "MSG_X.field" (or a bare
+    # field name, gating it in every message) -> gate constant in the
+    # protocol module. Any read (or keyword-serve into a schema
+    # constructor) of the field outside the protocol module must sit in a
+    # function — or under callers — comparing against that constant. TOML:
+    # a ``[tool.ldt-check.protocol-versions]`` table.
+    protocol_versions: Dict[str, str] = dataclasses.field(
+        default_factory=lambda: {
+            "MSG_HELLO.stripe_index": "STRIPE_MIN_VERSION",
+            "MSG_HELLO.stripe_count": "STRIPE_MIN_VERSION",
+        }
+    )
+    # LDT14xx: messages whose payloads are raw binary (framed tensors),
+    # not JSON field dicts — excluded from field-schema tracking.
+    protocol_binary: List[str] = dataclasses.field(
+        default_factory=lambda: ["MSG_BATCH"]
+    )
+    # LDT1403 runtime witness (``ldt check --wire-witness``): set by the
+    # CLI, never from TOML — {"frames": {msg_value: count}, "fields":
+    # {msg_value: {field: count}}} recorded by utils/wiretrack.py under
+    # LDT_WIRE_SANITIZER=1.
+    wire_witness: Optional[dict] = None
     # LDT601: the instrumented modules (telemetry clock + metric-name
     # hygiene) — no time.time(); metric names must be Prometheus-safe.
     obs_paths: List[str] = dataclasses.field(
@@ -185,6 +209,8 @@ def load_config(root: str) -> CheckConfig:
         "compat-symbols": "compat_symbols",
         "queue-paths": "queue_paths",
         "protocol-module": "protocol_module",
+        "protocol-versions": "protocol_versions",
+        "protocol-binary": "protocol_binary",
         "obs-paths": "obs_paths",
         "hot-paths": "hot_paths",
         "state-paths": "state_paths",

@@ -76,12 +76,12 @@ _SUPPRESS_RE = re.compile(
 
 # The cross-module rules: their findings assert whole-program properties
 # (a deadlock cycle, a cross-thread race, a leak-on-path, taint into a
-# content computation), so an unexplained per-line ignore is exactly the
-# "trust me" a reviewer cannot review. Suppressions for the concurrency
-# (LDT10xx), ownership (LDT12xx), and purity (LDT13xx) families require a
-# reason string:
+# content computation, a payload field one peer forgot), so an unexplained
+# per-line ignore is exactly the "trust me" a reviewer cannot review.
+# Suppressions for the concurrency (LDT10xx), ownership (LDT12xx), purity
+# (LDT13xx), and wire-protocol (LDT14xx) families require a reason string:
 #     # ldt: ignore[LDT1002] -- GIL-atomic monotonic cursor, torn reads ok
-_REASON_REQUIRED_RE = re.compile(r"LDT1[023]\d\d$")
+_REASON_REQUIRED_RE = re.compile(r"LDT1[0234]\d\d$")
 
 
 def _parse_suppressions(lines: Sequence[str]) -> Dict[int, tuple]:
@@ -424,14 +424,32 @@ def analyze_project(root: str, config, timing: Optional[dict] = None):
         t1 = _time.perf_counter()
         model_ms = {"concurrency": round((t1 - t0) * 1e3, 3)}
         if any(
+            getattr(rule, "uses_proto_model", False)
+            for rule in rules.values()
+        ):
+            from .protomodel import build_proto_model
+
+            tp = _time.perf_counter()
+            proto = build_proto_model(program, config)
+            model_ms["protocol"] = round(
+                (_time.perf_counter() - tp) * 1e3, 3
+            )
+            wire = getattr(config, "wire_witness", None)
+            if wire is not None and timing is not None:
+                # The corroboration receipt the CI wire-witness stage
+                # asserts on: how much of the runtime (msg, field)
+                # evidence maps onto the static schema.
+                timing["wire_witness"] = proto.witness_receipt(wire)
+        if any(
             getattr(rule, "uses_owner_model", False)
             for rule in rules.values()
         ):
             from .ownermodel import build_owner_model
 
+            t_own = _time.perf_counter()
             owner = build_owner_model(program, config)
             model_ms["ownership"] = round(
-                (_time.perf_counter() - t1) * 1e3, 3
+                (_time.perf_counter() - t_own) * 1e3, 3
             )
             witness = getattr(config, "leak_witness", None)
             if witness is not None and timing is not None:

@@ -79,10 +79,15 @@ def render_json(
 
     Additive v2 fields (r11): ``model_build_ms`` — per-family build time
     of the shared cross-module models ({"concurrency": ms, "ownership":
-    ms}), the receipt that one ProgramInfo/parse pass serves every
-    whole-program family — and ``leak_witness`` (only when ``ldt check
-    --leak-witness`` ran): {"runtime_sites", "matched_sites",
+    ms, "protocol": ms}), the receipt that one ProgramInfo/parse pass
+    serves every whole-program family — and ``leak_witness`` (only when
+    ``ldt check --leak-witness`` ran): {"runtime_sites", "matched_sites",
     "leaked_sites"}, the static↔runtime corroboration summary.
+
+    Additive v2 field (r14): ``wire_witness`` (only when ``ldt check
+    --wire-witness`` ran): {"observed_fields", "matched_fields",
+    "frames"} — how much of the runtime (msg, field) wire traffic maps
+    onto the static payload schema.
     """
     records = []
     for f in findings:
@@ -111,5 +116,7 @@ def render_json(
     }
     if (timing or {}).get("leak_witness") is not None:
         payload["leak_witness"] = timing["leak_witness"]
+    if (timing or {}).get("wire_witness") is not None:
+        payload["wire_witness"] = timing["wire_witness"]
     json.dump(payload, out, indent=2)
     out.write("\n")

@@ -24,4 +24,5 @@ from . import (  # noqa: F401  (import for registration side effect)
     resources,
     sharedstate,
     tunables,
+    wireproto,
 )

@@ -560,6 +560,13 @@ def main(argv=None) -> dict:
         from .analysis.cli import check_main
 
         return check_main(argv[1:])
+    if argv and argv[0] == "protocol":
+        # Wire-protocol golden corpus: decode every checked-in frame blob
+        # and re-encode it byte-identically per version (`ldt protocol
+        # goldens`, `--update` to regenerate). Returns an int exit status.
+        from .service.goldens import goldens_main
+
+        return goldens_main(argv[1:])
     if argv and argv[0] == "graph":
         # The cross-module concurrency model (thread roots, locks,
         # lock-order edges) as DOT (--dot) or a text summary.

@@ -65,6 +65,11 @@ class FleetAgent:
         self.backoff_s = backoff_s
         self.lease: Optional[dict] = None
         self.generation: int = 0
+        # Coordinator-advertised expiry horizon (REGISTER_OK lease_ttl_s):
+        # how long this member may go silent before its lease is reaped.
+        # Surfaced on /healthz so an operator can spot a heartbeat
+        # interval configured dangerously close to the TTL.
+        self.lease_ttl_s: float = 0.0
         self.registered = threading.Event()  # tests/healthz wait on this
         self._stop = threading.Event()
         self._paused = threading.Event()  # chaos: heartbeats held, not dead
@@ -117,6 +122,7 @@ class FleetAgent:
             self.heartbeat_interval_s = float(
                 reply.get("heartbeat_interval_s") or 2.0
             )
+        self.lease_ttl_s = float(reply.get("lease_ttl_s") or 0.0)
         self._apply_lease(reply)
         self._count("fleet_registrations")
         self.registered.set()
@@ -189,10 +195,20 @@ class FleetAgent:
             self._thread = None
         if deregister and self.registered.is_set():
             try:
-                msg_type, _reply = self._call(
+                msg_type, reply = self._call(
                     P.MSG_FLEET_DEREGISTER, {"server_id": self.server_id}
                 )
                 if msg_type == P.MSG_FLEET_DEREGISTER_OK:
+                    if self.counters is not None:
+                        # The post-leave generation: what the lease table
+                        # became because we left — the last fleet fact a
+                        # draining member can report (a gauge, not
+                        # self.generation: the heartbeat thread owns that
+                        # attribute).
+                        self.counters.gauge(
+                            "fleet_leave_generation",
+                            int(reply.get("generation") or 0),
+                        )
                     self._count("fleet_deregistrations")
                 else:
                     # An ERROR answer (or a future coordinator speaking a

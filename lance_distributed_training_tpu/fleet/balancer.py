@@ -607,6 +607,34 @@ class FleetLoader:
                             "(no stripe support) — upgrade it before "
                             "fleeting"
                         )
+                    # Stripe-echo check: the HELLO_OK carries back the
+                    # residue class the server will actually serve. A
+                    # server that accepted the handshake but mis-parsed,
+                    # DROPPED, or ignored the stripe fields would stream
+                    # the wrong class — duplicated steps on one stripe,
+                    # holes on another — with every frame individually
+                    # valid. The echo is REQUIRED (every v3 server has
+                    # sent it since striping existed): defaulting a
+                    # missing echo to the requested values would pass the
+                    # exact server this check exists to catch. Fatal like
+                    # the version floor above: a fleet serving wrong
+                    # residue classes cannot be failed over to.
+                    echoed = (
+                        reply.get("stripe_index"),
+                        reply.get("stripe_count"),
+                    )
+                    if not all(
+                        P.is_json_int(e) and e == want
+                        for e, want in zip(
+                            echoed, (stripe_index, stripe_count)
+                        )
+                    ):
+                        raise P.ProtocolError(
+                            f"data server {addr} echoed stripe "
+                            f"{echoed[0]!r}/{echoed[1]!r}, requested "
+                            f"{stripe_index}/{stripe_count} — it would "
+                            "serve the wrong residue class"
+                        )
                     self._num_steps = int(reply["num_steps"])  # ldt: ignore[LDT1002] -- idempotent plan-length cache: every writer stores the same value for a given epoch
                     sock.settimeout(None)  # streaming: no recv deadline
                     sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE,

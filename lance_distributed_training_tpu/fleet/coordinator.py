@@ -95,7 +95,8 @@ class _Member:
     """One registered data server and its current lease."""
 
     __slots__ = ("server_id", "addr", "num_fragments", "last_heartbeat",
-                 "stripe_index", "fragment_lo", "fragment_hi", "pressure")
+                 "stripe_index", "fragment_lo", "fragment_hi", "pressure",
+                 "acked_generation")
 
     def __init__(self, server_id: str, addr: str, num_fragments: int):
         self.server_id = server_id
@@ -105,6 +106,10 @@ class _Member:
         self.stripe_index = 0
         self.fragment_lo = 0
         self.fragment_hi = 0
+        # Last generation this member REPORTED in a heartbeat: lagging the
+        # table's generation means the member has not yet acted on its
+        # newest lease (the propagation-delay signal /healthz surfaces).
+        self.acked_generation = 0
         # Latest heartbeat-reported windowed pressure ({"stall_pct": …,
         # "active_clients": …}; None until a pressure-carrying heartbeat —
         # pre-r9 members never send one and simply stay None).
@@ -180,6 +185,7 @@ class Coordinator:
                     "fragment_lo": m.fragment_lo,
                     "fragment_hi": m.fragment_hi,
                     "heartbeat_age_s": round(now - m.last_heartbeat, 3),
+                    "acked_generation": m.acked_generation,
                     "pressure": m.pressure,
                 }
                 for m in members
@@ -277,6 +283,17 @@ class Coordinator:
 
     def _handle_heartbeat(self, req: dict) -> tuple:
         server_id = str(req.get("server_id") or "")
+        # Field-TYPE validation BEFORE any state moves (the same
+        # discipline protocol.hello_malformed gives the HELLO): a
+        # malformed heartbeat must neither refresh the member's liveness
+        # nor die as a ValueError repr — answer a diagnosable rejection
+        # and leave the lease clock untouched.
+        gen = req.get("generation")
+        if gen is not None and not P.is_json_int(gen):
+            return P.MSG_ERROR, {"message": (
+                "malformed heartbeat field 'generation': expected "
+                f"integer, got {type(gen).__name__} {gen!r}"
+            )}
         with self._lock:
             member = self._members.get(server_id)
             if member is None:
@@ -288,6 +305,15 @@ class Coordinator:
                                "re-register"
                 }
             member.last_heartbeat = time.monotonic()
+            if gen is not None:
+                # The generation the member is acting on: a lag against
+                # self.generation means its lease reply is still in
+                # flight (or it is re-planning) — visible per member on
+                # /healthz. A heartbeat WITHOUT the field (a minimal
+                # foreign peer) keeps the last known value rather than
+                # fabricating a permanent generation-0 stuck-lease
+                # signal.
+                member.acked_generation = int(gen)
             pressure = req.get("pressure")
             if isinstance(pressure, dict):
                 member.pressure = dict(pressure)

@@ -52,6 +52,18 @@ registry — README "Batch cache" for the full glossary):
 ``cache_disk_entries`` occupancy gauges, the ``cache_lookup_ms``
 histogram, and the HBM replay tier's ``cache_device_batches`` gauge +
 ``cache_device_replay_epochs_total`` counter.
+
+Protocol series (r14 — README "Protocol"):
+
+* ``svc_proto_malformed_hello`` — counter: HELLOs rejected at the type
+  gate (``protocol.hello_malformed``) with a skew-style MSG_ERROR — a
+  mixed-version or corrupted peer sending a wrong-typed field, answered
+  diagnosably instead of a handler-killing ValueError;
+* ``fleet_leave_generation`` — gauge: the lease-table generation a
+  member's graceful deregister produced (its last fleet fact);
+* the opt-in wire witness (``LDT_WIRE_SANITIZER=1``,
+  ``utils/wiretrack.py``) records off-registry — per-(msg, field) wire
+  counts feed ``ldt check --wire-witness``, not ``/metrics``.
 """
 
 from .http import MetricsHTTPServer  # noqa: F401

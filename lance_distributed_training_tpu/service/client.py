@@ -308,6 +308,24 @@ class RemoteLoader:
                     f"client supports {P.MIN_PROTOCOL_VERSION}.."
                     f"{P.PROTOCOL_VERSION}"
                 )
+            # Cursor-echo check (LDT1401 closes the loop on every HELLO_OK
+            # field): the server slices its plan at the echoed start_step —
+            # an echo that disagrees with the request means the stream will
+            # begin at the wrong step and every later ACK/resume cursor is
+            # silently off by the difference. v1 servers echo it too, so
+            # the .get default only covers a hand-rolled test double.
+            echoed_start = reply.get("start_step", int(start_step))
+            if not P.is_json_int(echoed_start) or \
+                    echoed_start != int(start_step):
+                # Type-checked (the shared JSON-int predicate), not
+                # int()-coerced: a garbage echo must be THIS diagnosable
+                # rejection, never a raw ValueError escaping the retry
+                # loop (the handler-killing-repr class hello_malformed
+                # fixes server-side).
+                raise P.ProtocolError(
+                    f"server echoed start_step {echoed_start!r}, "
+                    f"requested {start_step} — plan-cursor desync"
+                )
             self._num_steps = int(reply["num_steps"])  # ldt: ignore[LDT1002] -- idempotent plan-length cache: every writer stores the same value for a given epoch
             # Streaming phase: no recv deadline. A slow step (cold
             # decode, read retries, busy shared pool) must NOT be

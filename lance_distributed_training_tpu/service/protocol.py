@@ -61,12 +61,16 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..utils import wiretrack
+
 __all__ = [
     "PROTOCOL_VERSION",
     "MIN_PROTOCOL_VERSION",
     "LINEAGE_MIN_VERSION",
     "STRIPE_MIN_VERSION",
     "version_supported",
+    "is_json_int",
+    "hello_malformed",
     "VERSION_MISMATCH_MARKER",
     "MSG_HELLO",
     "MSG_HELLO_OK",
@@ -124,6 +128,65 @@ def version_supported(version) -> bool:
         and not isinstance(version, bool)  # JSON true is not a version
         and MIN_PROTOCOL_VERSION <= version <= PROTOCOL_VERSION
     )
+
+
+def is_json_int(value) -> bool:
+    """Is ``value`` a JSON integer (bool excluded — JSON ``true`` is not a
+    count)? The ONE predicate every peer's type check shares: the
+    server's ``hello_malformed`` gate and the client/balancer echo
+    validations must never diverge on the bool-is-an-int subtlety."""
+    return isinstance(value, int) and not isinstance(value, bool)
+
+
+# HELLO field type vocabulary: (field, predicate over a non-None value,
+# human-readable expectation). Optional fields (None = undeclared) skip the
+# check, like the skew checks they feed. The schema owner declares the
+# types in ONE place so the server's rejection and the analyzer's golden
+# corpus never drift apart.
+_HELLO_FIELD_TYPES = (
+    ("batch_size", is_json_int, "integer"),
+    ("process_index", is_json_int, "integer"),
+    ("process_count", is_json_int, "integer"),
+    ("seed", is_json_int, "integer"),
+    ("epoch", is_json_int, "integer"),
+    ("start_step", is_json_int, "integer"),
+    ("stripe_index", is_json_int, "integer"),
+    ("stripe_count", is_json_int, "integer"),
+    ("image_size", is_json_int, "integer"),
+    ("sampler_type", lambda v: isinstance(v, str), "string"),
+    ("client_id", lambda v: isinstance(v, str), "string"),
+    ("task_type", lambda v: isinstance(v, str), "string"),
+    ("dataset_fingerprint", lambda v: isinstance(v, str), "string"),
+    ("shuffle", lambda v: isinstance(v, bool), "boolean"),
+    ("probe", lambda v: isinstance(v, bool), "boolean"),
+    ("device_decode", lambda v: isinstance(v, bool), "boolean"),
+    (
+        "columns",
+        lambda v: isinstance(v, list)
+        and all(isinstance(c, str) for c in v),
+        "list of strings",
+    ),
+)
+
+
+def hello_malformed(req: dict) -> Optional[str]:
+    """First malformed-TYPE problem in a HELLO payload, or ``None``.
+
+    The handshake must answer a skew-style MSG_ERROR for a field of the
+    wrong JSON type (a foreign or corrupted client sending
+    ``image_size="abc"``): before this check, such a value reached
+    ``int(size)`` inside ``decode_config_skew`` and killed the handler
+    with a ValueError repr instead of a diagnosable connect-time
+    rejection. Validated HERE, by the schema owner, so every field the
+    skew checks or ``plan_for`` later coerce is already type-sound."""
+    for field, ok, expected in _HELLO_FIELD_TYPES:
+        value = req.get(field)
+        if value is not None and not ok(value):
+            return (
+                f"malformed HELLO field {field!r}: expected {expected}, "
+                f"got {type(value).__name__} {value!r}"
+            )
+    return None
 
 
 # Message types (one byte on the wire).
@@ -242,6 +305,10 @@ def recv_frame(
 def send_msg(sock: socket.socket, msg_type: int, payload: dict) -> None:
     """Send a control message (JSON dict payload — never pickle: control
     frames arrive from the network before any trust is established)."""
+    if wiretrack.enabled():
+        # Wire witness (LDT1403's evidence half): which (msg, field)
+        # tuples actually cross the wire. Two attribute loads when off.
+        wiretrack.record_frame(msg_type, payload)
     send_frame(sock, msg_type, json.dumps(payload).encode("utf-8"))
 
 
@@ -254,6 +321,8 @@ def recv_msg(
     for handshake frames, never for the streaming phase."""
     msg_type, payload = recv_frame(sock, deadline)
     if msg_type == MSG_BATCH:
+        if wiretrack.enabled():
+            wiretrack.record_frame(msg_type, None)
         return msg_type, {"raw": payload}
     try:
         out = json.loads(bytes(payload).decode("utf-8"))
@@ -263,6 +332,11 @@ def recv_msg(
         )
     if not isinstance(out, dict):
         raise ProtocolError(f"control frame type {msg_type} is not a dict")
+    if wiretrack.enabled():
+        # Receive-side recording too: a frame from a FOREIGN writer (the
+        # exact blind spot the witness prunes LDT1403 with) is only ever
+        # seen here.
+        wiretrack.record_frame(msg_type, out)
     return msg_type, out
 
 
@@ -487,6 +561,8 @@ class FrameReader:
         payload = memoryview(self._buf)[:length]
         self._recv_exact_into(payload, deadline)
         if msg_type == MSG_BATCH:
+            if wiretrack.enabled():
+                wiretrack.record_frame(msg_type, None)
             return msg_type, {"raw": payload}
         try:
             out = json.loads(bytes(payload).decode("utf-8"))
@@ -496,6 +572,8 @@ class FrameReader:
             )
         if not isinstance(out, dict):
             raise ProtocolError(f"control frame type {msg_type} is not a dict")
+        if wiretrack.enabled():
+            wiretrack.record_frame(msg_type, out)
         return msg_type, out
 
 

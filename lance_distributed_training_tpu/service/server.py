@@ -177,6 +177,17 @@ class _ClientSession:
             self.peer_version = min(
                 int(req["version"]), P.PROTOCOL_VERSION
             )
+            # Field-TYPE validation before anything coerces a value: a
+            # malformed field (image_size="abc") must answer a skew-style
+            # MSG_ERROR at connect time, not kill this handler with the
+            # ValueError `int()` would raise inside decode_config_skew or
+            # plan_for (the rejection a mixed-version or corrupted peer
+            # can actually diagnose).
+            bad = P.hello_malformed(req)
+            if bad:
+                svc.counters.add("proto_malformed_hello")
+                P.send_msg(self.sock, P.MSG_ERROR, {"message": bad})
+                return
             self.client_id = req.get("client_id", "")
             skew = svc.decode_config_skew(req)
             if skew:
@@ -867,6 +878,9 @@ class DataService:
                 "registered": agent.registered.is_set(),
                 "lease": agent.lease,
                 "generation": agent.generation,
+                # Coordinator-advertised expiry horizon: an operator can
+                # spot a heartbeat interval configured too close to it.
+                "lease_ttl_s": agent.lease_ttl_s,
             }
         return {
             # Non-"ok" serves as HTTP 503 (obs.http): a probe pointed here

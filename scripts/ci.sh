@@ -48,12 +48,20 @@
 # bit-identical to a --no_batch_cache control arm, zero leaked BufferPool
 # leases under the leak sanitizer, and zero stray spill temp files (every
 # disk segment committed atomically via os.replace).
+# Stage 7d — protocol golden corpus (`ldt protocol goldens`): every
+# checked-in frame blob — v1 bare HELLO through v3 striped/coeff/lineage/
+# fingerprint and the fleet control plane — must decode with the current
+# build and re-encode byte-identically per version; the current encoders
+# must reproduce every blob exactly (constructor/framing drift fails the
+# gate; `ldt protocol goldens --update` regenerates a reviewable diff).
 # Stage 8 — the tier-1 verify command from ROADMAP.md, verbatim — run
-# under LDT_LOCK_SANITIZER=1 AND LDT_LEAK_SANITIZER=1: every
-# threading.Lock/RLock the package creates is wrapped to record actual
-# acquisition orderings, every BufferPool page lease/release and shm slot
-# token handoff is recorded against its acquire site, and conftest dumps
-# both witness JSONs on exit.
+# under LDT_LOCK_SANITIZER=1, LDT_LEAK_SANITIZER=1 AND
+# LDT_WIRE_SANITIZER=1: every threading.Lock/RLock the package creates is
+# wrapped to record actual acquisition orderings, every BufferPool page
+# lease/release and shm slot token handoff is recorded against its
+# acquire site, every control frame's (msg, field) tuples are counted as
+# they cross the loopback wire, and conftest dumps all three witness
+# JSONs on exit.
 # Stage 9 — `ldt check --lock-witness` against the lock witness: the
 # runtime evidence corroborates (or prunes) the static LDT1001 lock-order
 # cycles, and any NEW LDT10xx finding fails the build exactly like stage 1.
@@ -63,6 +71,11 @@
 # corroborates the model (>= 1 runtime site matching a static acquire
 # site — a zero-overlap witness means the sanitizer hooks or the
 # ownership model silently rotted).
+# Stage 11 — `ldt check --wire-witness` against the wire witness: observed
+# (msg, field) traffic corroborates (or prunes) the static LDT1403
+# orphan-read findings, with the same >= 1 matched-tuple receipt — a
+# zero-overlap witness means the protocol hooks or the schema model
+# silently rotted.
 set -e
 cd "$(dirname "$0")/.."
 
@@ -171,11 +184,19 @@ echo "== batch-cache smoke (epoch-2 hits, digest parity, leak-clean) =="
 # leases under LDT_LEAK_SANITIZER=1 and zero stray spill temp files.
 timeout -k 10 540 env JAX_PLATFORMS=cpu LDT_LEAK_SANITIZER=1 PYTHONPATH=. python scripts/cache_smoke.py
 
-echo "== tier-1 tests (lock + leak sanitizers on) =="
+echo "== protocol goldens (cross-version byte-identity gate) =="
+# Every checked-in frame blob decodes with the current build and
+# re-encodes byte-identically per version; the current encoders must
+# reproduce every blob (wire-format drift fails here, with --update as
+# the reviewable escape hatch).
+timeout -k 10 120 env JAX_PLATFORMS=cpu PYTHONPATH=. python -m lance_distributed_training_tpu.cli protocol goldens
+
+echo "== tier-1 tests (lock + leak + wire sanitizers on) =="
 WITNESS=/tmp/_ldt_lock_witness.json
 LEAK_WITNESS=/tmp/_ldt_leak_witness.json
-rm -f "$WITNESS" "$LEAK_WITNESS"
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu LDT_LOCK_SANITIZER=1 LDT_LOCK_WITNESS_PATH="$WITNESS" LDT_LEAK_SANITIZER=1 LDT_LEAK_WITNESS_PATH="$LEAK_WITNESS" python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+WIRE_WITNESS=/tmp/_ldt_wire_witness.json
+rm -f "$WITNESS" "$LEAK_WITNESS" "$WIRE_WITNESS"
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu LDT_LOCK_SANITIZER=1 LDT_LOCK_WITNESS_PATH="$WITNESS" LDT_LEAK_SANITIZER=1 LDT_LEAK_WITNESS_PATH="$LEAK_WITNESS" LDT_WIRE_SANITIZER=1 LDT_WIRE_WITNESS_PATH="$WIRE_WITNESS" python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
 echo "== lock-order witness cross-check =="
@@ -194,3 +215,13 @@ test -s "$LEAK_WITNESS" || { echo "missing leak witness $LEAK_WITNESS"; exit 1; 
 python scripts/ldt_check.py --leak-witness "$LEAK_WITNESS" | tee /tmp/_leakcheck.log
 grep -E 'leak witness: [1-9][0-9]*/[0-9]+ runtime sites match' /tmp/_leakcheck.log \
   || { echo "leak witness corroborated no static acquire site"; exit 1; }
+
+echo "== wire-traffic witness cross-check =="
+# The instrumented run's (msg, field) wire evidence, fed back into the
+# LDT1403 gate — and an assertion that the witness actually overlaps the
+# static schema: at least one observed tuple must match a modeled field,
+# or the corroboration loop is dead machinery.
+test -s "$WIRE_WITNESS" || { echo "missing wire witness $WIRE_WITNESS"; exit 1; }
+python scripts/ldt_check.py --wire-witness "$WIRE_WITNESS" | tee /tmp/_wirecheck.log
+grep -E 'wire witness: [1-9][0-9]*/[0-9]+ observed \(msg, field\) tuples match' /tmp/_wirecheck.log \
+  || { echo "wire witness corroborated no static schema field"; exit 1; }

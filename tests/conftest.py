@@ -77,6 +77,14 @@ def pytest_unconfigure(config):
         leaktrack = _load_util("leaktrack")
         path = leaktrack.dump()
         sys.stderr.write(f"\n[leaktrack] witness written to {path}\n")
+    if os.environ.get("LDT_WIRE_SANITIZER") == "1":
+        # Wire-traffic witness (LDT1403's evidence half): the protocol
+        # hooks counted every (msg, field) tuple that crossed the
+        # loopback wire across the suite — dump for
+        # `ldt check --wire-witness`.
+        wiretrack = _load_util("wiretrack")
+        path = wiretrack.dump()
+        sys.stderr.write(f"\n[wiretrack] witness written to {path}\n")
 
 
 def _load_util(stem):

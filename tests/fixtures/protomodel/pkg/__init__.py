@@ -1,0 +1,14 @@
+"""Seeded wire-protocol fixture package for the LDT1401-1404 tests.
+
+Planted findings (and only these):
+
+* ``proto.py`` — ``ping()``'s ``new_knob`` field: written on the wire,
+  never read by the peer (LDT1401);
+* ``server.py`` — an ungated read of the version-gated ``feature`` field
+  (LDT1402) and a read of ``ghost``, which no sender writes (LDT1403);
+* ``framing.py`` — raw ``struct.pack`` framing outside the protocol
+  module (LDT1404).
+
+Everything else is a negative control: written-and-read fields, a
+guarded gated read, and the protocol module's own (allowed) framing.
+"""

@@ -1,0 +1,11 @@
+"""Fixture sending peer: clean writer + reader (negative controls)."""
+
+from . import proto
+
+
+def call(sock):
+    proto.send_msg(sock, proto.MSG_PING, proto.ping())
+    msg_type, reply = proto.recv_msg(sock)
+    if msg_type != proto.MSG_PONG:
+        raise ValueError(msg_type)
+    return reply.get("ok")

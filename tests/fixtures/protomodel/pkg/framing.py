@@ -1,0 +1,7 @@
+"""Fixture out-of-module framing: the planted LDT1404."""
+
+import struct
+
+
+def sneak_frame(msg_type, payload):
+    return struct.pack(">IB", len(payload), msg_type) + payload

@@ -1,0 +1,30 @@
+"""Fixture wire protocol: two messages, one gated feature field."""
+
+import struct
+
+PROTOCOL_VERSION = 3
+MIN_PROTOCOL_VERSION = 1
+FEATURE_MIN_VERSION = 3
+
+MSG_PING = 1
+MSG_PONG = 2
+
+
+def send_msg(sock, msg_type, payload):
+    """Fixture send path — framing (struct) is ALLOWED in this module."""
+    sock.sendall(struct.pack(">IB", 0, msg_type))
+
+
+def recv_msg(sock):
+    """Fixture receive path: the (msg_type, payload) tuple shape."""
+    return MSG_PING, {}
+
+
+def ping(version=PROTOCOL_VERSION):
+    """PING constructor: ``new_knob`` is the planted orphan write."""
+    return {
+        "version": version,
+        "payload_size": 8,
+        "new_knob": True,
+        "feature": None,
+    }

@@ -1,0 +1,23 @@
+"""Fixture receiving peer: one ungated gated-field read, one ghost read."""
+
+from . import proto
+
+
+class Server:
+    def handle(self, sock):
+        msg_type, req = proto.recv_msg(sock)
+        if msg_type != proto.MSG_PING:
+            raise ValueError(msg_type)
+        size = req.get("payload_size")  # control: written AND read
+        version = req.get("version")  # control: written AND read
+        feature = req.get("feature")  # planted LDT1402: no version guard
+        ghost = req.get("ghost")  # planted LDT1403: nobody writes it
+        gated = self.feature_guarded(req, version)
+        proto.send_msg(sock, proto.MSG_PONG, {"ok": True})
+        return size, feature, ghost, gated
+
+    def feature_guarded(self, req, peer_version):
+        """Negative control: the SAME gated read behind the gate."""
+        if peer_version is None or peer_version < proto.FEATURE_MIN_VERSION:
+            return None
+        return req.get("feature")

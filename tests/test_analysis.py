@@ -2466,7 +2466,7 @@ def test_json_reports_model_build_ms(tmp_path):
     assert rc == 0
     data = json.loads(out.getvalue())
     build = data["model_build_ms"]
-    assert set(build) == {"concurrency", "ownership"}
+    assert set(build) == {"concurrency", "protocol", "ownership"}
     assert all(isinstance(v, (int, float)) and v >= 0
                for v in build.values())
 
@@ -2525,3 +2525,787 @@ def test_graph_ownership_cli_dispatch():
     rc = cli.main(["graph", "--root", str(OWNER_FIXTURE_ROOT), "pkg",
                    "--ownership"])
     assert rc == 0
+
+
+# -- LDT1401-1404 wire-protocol evolution (analysis/protomodel.py) ------------
+
+
+PROTO_FIXTURE_ROOT = REPO_ROOT / "tests" / "fixtures" / "protomodel"
+
+
+def _proto_config(**kwargs):
+    kwargs.setdefault("paths", ["pkg"])
+    kwargs.setdefault("queue_paths", [])
+    kwargs.setdefault("protocol_module", "pkg/proto.py")
+    kwargs.setdefault("protocol_binary", [])
+    kwargs.setdefault(
+        "protocol_versions", {"MSG_PING.feature": "FEATURE_MIN_VERSION"}
+    )
+    kwargs.setdefault("dispatch", {})
+    kwargs.setdefault("content_paths", [])
+    return CheckConfig(**kwargs)
+
+
+_WIRE_PROTO = """\
+    MSG_A = 1
+    MSG_B = 2
+    PROTOCOL_VERSION = 3
+    GADGET_MIN_VERSION = 3
+
+    def send_msg(sock, msg_type, payload):
+        sock.sendall(payload)
+
+    def recv_msg(sock):
+        return MSG_A, {}
+"""
+
+
+def _wire_rules(tmp_path, files, **kwargs):
+    files = dict(files)
+    files.setdefault("proto.py", _WIRE_PROTO)
+    kwargs.setdefault("protocol_module", "proto.py")
+    kwargs.setdefault("protocol_binary", [])
+    kwargs.setdefault("protocol_versions", {})
+    kwargs.setdefault("dispatch", {})
+    kwargs.setdefault("content_paths", [])
+    return run_rules(tmp_path, files, **kwargs)
+
+
+def test_ldt1401_flags_written_never_read_field(tmp_path):
+    findings = _wire_rules(tmp_path, {
+        "writer.py": """\
+            import proto
+
+            def send(sock):
+                proto.send_msg(sock, proto.MSG_A,
+                               {"used": 1, "forgotten": 2})
+        """,
+        "reader.py": """\
+            import proto
+
+            def handle(sock):
+                msg_type, req = proto.recv_msg(sock)
+                if msg_type != proto.MSG_A:
+                    raise ValueError(msg_type)
+                return req.get("used")
+        """,
+    })
+    assert rule_ids(findings) == ["LDT1401"]
+    assert findings[0].path == "writer.py"
+    assert "'forgotten'" in findings[0].message
+
+
+def test_ldt1401_protocol_module_reads_do_not_count(tmp_path):
+    """The schema owner validating its own dict proves nothing about the
+    peer — exactly why deleting a decode_config_skew check must fail."""
+    findings = _wire_rules(tmp_path, {
+        "proto.py": _WIRE_PROTO + """\
+
+    def validate(req):
+        return req.get("knob") is not None
+    """,
+        "writer.py": """\
+            import proto
+
+            def send(sock):
+                proto.send_msg(sock, proto.MSG_A, {"knob": 1})
+        """,
+    })
+    assert rule_ids(findings) == ["LDT1401"]
+    assert "'knob'" in findings[0].message
+
+
+def test_ldt1401_interprocedural_skew_check_read_satisfies(tmp_path):
+    """A read through a parameter-passed helper (the decode_config_skew
+    shape: run() hands the HELLO dict to a checker) counts."""
+    findings = _wire_rules(tmp_path, {
+        "writer.py": """\
+            import proto
+
+            def send(sock):
+                proto.send_msg(sock, proto.MSG_A, {"knob": 1})
+        """,
+        "reader.py": """\
+            import proto
+
+            def skew(req):
+                return req.get("knob")
+
+            def handle(sock):
+                msg_type, req = proto.recv_msg(sock)
+                if msg_type != proto.MSG_A:
+                    raise ValueError(msg_type)
+                return skew(req)
+        """,
+    })
+    assert findings == []
+
+
+def test_ldt1401_constructor_function_writes_tracked(tmp_path):
+    """Fields written through a dict-returning constructor (the
+    protocol.hello shape) are write sites at the constructor's key
+    lines."""
+    findings = _wire_rules(tmp_path, {
+        "proto.py": _WIRE_PROTO + """\
+
+    def make_a(knob):
+        return {"knob": knob, "dead": 0}
+    """,
+        "writer.py": """\
+            import proto
+
+            def send(sock):
+                proto.send_msg(sock, proto.MSG_A, proto.make_a(3))
+        """,
+        "reader.py": """\
+            import proto
+
+            def handle(sock):
+                msg_type, req = proto.recv_msg(sock)
+                if msg_type != proto.MSG_A:
+                    raise ValueError(msg_type)
+                return req.get("knob")
+        """,
+    })
+    assert rule_ids(findings) == ["LDT1401"]
+    assert findings[0].path == "proto.py" and "'dead'" in findings[0].message
+
+
+def test_ldt1402_flags_ungated_versioned_read(tmp_path):
+    findings = _wire_rules(tmp_path, {
+        "writer.py": """\
+            import proto
+
+            def send(sock):
+                proto.send_msg(sock, proto.MSG_A, {"gadget": 1})
+        """,
+        "reader.py": """\
+            import proto
+
+            def handle(sock):
+                msg_type, req = proto.recv_msg(sock)
+                if msg_type != proto.MSG_A:
+                    raise ValueError(msg_type)
+                return req.get("gadget")
+        """,
+    }, protocol_versions={"MSG_A.gadget": "GADGET_MIN_VERSION"})
+    assert rule_ids(findings) == ["LDT1402"]
+    assert "GADGET_MIN_VERSION" in findings[0].message
+
+
+def test_ldt1402_gate_in_function_passes(tmp_path):
+    findings = _wire_rules(tmp_path, {
+        "writer.py": """\
+            import proto
+
+            def send(sock):
+                proto.send_msg(sock, proto.MSG_A, {"gadget": 1})
+        """,
+        "reader.py": """\
+            import proto
+
+            def handle(sock, peer_version):
+                msg_type, req = proto.recv_msg(sock)
+                if msg_type != proto.MSG_A:
+                    raise ValueError(msg_type)
+                if peer_version < proto.GADGET_MIN_VERSION:
+                    raise ValueError(peer_version)
+                return req.get("gadget")
+        """,
+    }, protocol_versions={"MSG_A.gadget": "GADGET_MIN_VERSION"})
+    assert findings == []
+
+
+def test_ldt1402_gate_in_caller_passes(tmp_path):
+    """The balancer._hello shape: the helper serving the gated field has
+    no guard of its own, but its only caller does."""
+    findings = _wire_rules(tmp_path, {
+        "writer.py": """\
+            import proto
+
+            def build(gadget):
+                return {"gadget": gadget}
+
+            def helper(sock, gadget):
+                proto.send_msg(sock, proto.MSG_A, build(gadget=gadget))
+
+            def send(sock, peer_version):
+                if peer_version < proto.GADGET_MIN_VERSION:
+                    raise ValueError(peer_version)
+                helper(sock, 1)
+        """,
+        "reader.py": """\
+            import proto
+
+            def handle(sock, peer_version):
+                msg_type, req = proto.recv_msg(sock)
+                if peer_version < proto.GADGET_MIN_VERSION:
+                    raise ValueError(peer_version)
+                if msg_type != proto.MSG_A:
+                    raise ValueError(msg_type)
+                return req.get("gadget")
+        """,
+    }, protocol_versions={"MSG_A.gadget": "GADGET_MIN_VERSION"})
+    assert findings == []
+
+
+def test_ldt1402_kwarg_serve_fires_for_qualified_gate_keys(tmp_path):
+    """Regression: the keyword-serve half (passing a gated field into a
+    schema constructor) must fire for 'MSG_X.field'-qualified config
+    entries — the shipped pyproject uses only those; a bare-name
+    pre-filter silently disabled the serve check."""
+    files = {
+        "proto.py": _WIRE_PROTO + """\
+
+    def make_a(gadget):
+        return {"gadget": gadget}
+    """,
+        "writer.py": """\
+            import proto
+
+            def send(sock, gadget):
+                proto.send_msg(sock, proto.MSG_A, proto.make_a(
+                    gadget=gadget
+                ))
+        """,
+        "reader.py": """\
+            import proto
+
+            def handle(sock, peer_version):
+                msg_type, req = proto.recv_msg(sock)
+                if peer_version < proto.GADGET_MIN_VERSION:
+                    raise ValueError(peer_version)
+                if msg_type != proto.MSG_A:
+                    raise ValueError(msg_type)
+                return req.get("gadget")
+        """,
+    }
+    ungated = _wire_rules(
+        tmp_path, files,
+        protocol_versions={"MSG_A.gadget": "GADGET_MIN_VERSION"},
+    )
+    assert rule_ids(ungated) == ["LDT1402"]
+    assert ungated[0].path == "writer.py"
+    # The same serve under a guard is the negative control.
+    guarded = dict(files)
+    guarded["writer.py"] = """\
+        import proto
+
+        def send(sock, gadget, peer_version):
+            if peer_version < proto.GADGET_MIN_VERSION:
+                raise ValueError(peer_version)
+            proto.send_msg(sock, proto.MSG_A, proto.make_a(
+                gadget=gadget
+            ))
+    """
+    assert _wire_rules(
+        tmp_path, guarded,
+        protocol_versions={"MSG_A.gadget": "GADGET_MIN_VERSION"},
+    ) == []
+
+
+def test_ldt1402_recursive_helpers_under_a_guarded_entry_pass(tmp_path):
+    """Regression: a gated read inside a mutually recursive helper chain
+    whose only external entry holds the guard is guarded — the recursion
+    back-edge is not an unguarded entry path (the SCC fixpoint, not a
+    path-order-dependent DFS)."""
+    findings = _wire_rules(tmp_path, {
+        "reader.py": """\
+            import proto
+
+            def use(req):
+                return req.get("gadget")
+
+            def rec(req, n):
+                if n:
+                    return rec2(req, n - 1)
+                return use(req)
+
+            def rec2(req, n):
+                return rec(req, n)
+
+            def entry(sock, peer_version):
+                msg_type, req = proto.recv_msg(sock)
+                if msg_type != proto.MSG_A:
+                    raise ValueError(msg_type)
+                if peer_version < proto.GADGET_MIN_VERSION:
+                    raise ValueError(peer_version)
+                return rec(req, 3)
+        """,
+        "writer.py": """\
+            import proto
+
+            def send(sock):
+                proto.send_msg(sock, proto.MSG_A, {"gadget": 1})
+        """,
+    }, protocol_versions={"MSG_A.gadget": "GADGET_MIN_VERSION"})
+    assert findings == []
+
+
+def test_ldt1402_recursion_under_unguarded_entry_stays_flagged(tmp_path):
+    """The sound direction: the SCC fixpoint must not launder a cycle
+    into guardedness when its external entry has no guard."""
+    findings = _wire_rules(tmp_path, {
+        "reader.py": """\
+            import proto
+
+            def handle(sock):
+                msg_type, req = proto.recv_msg(sock)
+                if msg_type != proto.MSG_A:
+                    raise ValueError(msg_type)
+                return loop_a(req, 2)
+
+            def loop_a(req, n):
+                if n:
+                    return loop_b(req, n - 1)
+                return req.get("gadget")
+
+            def loop_b(req, n):
+                return loop_a(req, n)
+        """,
+        "writer.py": """\
+            import proto
+
+            def send(sock):
+                proto.send_msg(sock, proto.MSG_A, {"gadget": 1})
+        """,
+    }, protocol_versions={"MSG_A.gadget": "GADGET_MIN_VERSION"})
+    assert rule_ids(findings) == ["LDT1402"]
+
+
+def test_ldt1402_config_drift_is_a_finding(tmp_path):
+    findings = _wire_rules(tmp_path, {
+        "writer.py": """\
+            import proto
+
+            def send(sock):
+                proto.send_msg(sock, proto.MSG_A, {"x": 1})
+        """,
+        "reader.py": """\
+            import proto
+
+            def handle(sock):
+                msg_type, req = proto.recv_msg(sock)
+                if msg_type != proto.MSG_A:
+                    raise ValueError(msg_type)
+                return req.get("x")
+        """,
+    }, protocol_versions={"MSG_A.x": "ABSENT_MIN_VERSION"})
+    drift = [f for f in findings if f.rule == "LDT1402"]
+    assert drift and "ABSENT_MIN_VERSION" in drift[0].message
+    assert "config drift" in drift[0].message
+
+
+def test_ldt1403_flags_read_without_writer(tmp_path):
+    findings = _wire_rules(tmp_path, {
+        "writer.py": """\
+            import proto
+
+            def send(sock):
+                proto.send_msg(sock, proto.MSG_A, {"real": 1})
+        """,
+        "reader.py": """\
+            import proto
+
+            def handle(sock):
+                msg_type, req = proto.recv_msg(sock)
+                if msg_type != proto.MSG_A:
+                    raise ValueError(msg_type)
+                return req.get("real"), req.get("phantom")
+        """,
+    })
+    assert rule_ids(findings) == ["LDT1403"]
+    assert findings[0].path == "reader.py"
+    assert "'phantom'" in findings[0].message
+
+
+def test_ldt1403_handler_dict_reads_attributed(tmp_path):
+    """The coordinator shape: handlers dispatched through a
+    {MSG: method} dict get their request parameter's message role."""
+    findings = _wire_rules(tmp_path, {
+        "writer.py": """\
+            import proto
+
+            def send(sock):
+                proto.send_msg(sock, proto.MSG_A, {"real": 1})
+        """,
+        "reader.py": """\
+            import proto
+
+            class Handler:
+                def _on_a(self, req):
+                    return req.get("real"), req.get("specter")
+
+                def serve(self, sock):
+                    msg_type, req = proto.recv_msg(sock)
+                    handler = {proto.MSG_A: self._on_a}.get(msg_type)
+                    if handler is None:
+                        raise ValueError(msg_type)
+                    return handler(req)
+        """,
+    })
+    assert rule_ids(findings) == ["LDT1403"]
+    assert "'specter'" in findings[0].message
+
+
+def test_ldt1404_flags_struct_outside_protocol_module(tmp_path):
+    findings = _wire_rules(tmp_path, {
+        "framer.py": """\
+            import struct
+
+            def frame(payload):
+                return struct.pack(">I", len(payload)) + payload
+        """,
+    })
+    assert rule_ids(findings) == ["LDT1404"]
+    assert "struct.pack" in findings[0].message
+
+
+def test_ldt1404_protocol_module_framing_allowed(tmp_path):
+    findings = _wire_rules(tmp_path, {
+        "proto.py": """\
+            import struct
+
+            MSG_A = 1
+            _HEADER = struct.Struct(">IB")
+
+            def send_msg(sock, msg_type, payload):
+                sock.sendall(struct.pack(">I", len(payload)))
+
+            def recv_msg(sock):
+                return MSG_A, {}
+        """,
+    })
+    assert findings == []
+
+
+def test_ldt14xx_ignores_require_reason(tmp_path):
+    bare = _wire_rules(tmp_path, {
+        "framer.py": """\
+            import struct
+
+            def frame(payload):
+                return struct.pack(">I", 0) + payload  # ldt: ignore[LDT1404]
+        """,
+    })
+    assert rule_ids(bare) == ["LDT1404"]  # reasonless: stays live
+    reasoned = _wire_rules(tmp_path, {
+        "framer.py": """\
+            import struct
+
+            def frame(payload):
+                return struct.pack(">I", 0) + payload  # ldt: ignore[LDT1404] -- bench-only fake frame, never on a real wire
+        """,
+    })
+    assert reasoned == []
+
+
+# -- the seeded protomodel fixture package ------------------------------------
+
+
+def test_protomodel_fixture_yields_exactly_the_planted_findings():
+    findings = analyze(str(PROTO_FIXTURE_ROOT), _proto_config())
+    assert [(f.rule, f.path, f.line) for f in findings] == [
+        ("LDT1404", "pkg/framing.py", 7),
+        ("LDT1401", "pkg/proto.py", 28),
+        ("LDT1402", "pkg/server.py", 13),
+        ("LDT1403", "pkg/server.py", 14),
+    ], [f"{f.rule} {f.location()}" for f in findings]
+
+
+def test_wire_witness_prunes_observed_orphan_read():
+    """A (msg, field) tuple the instrumented run saw on the wire proves a
+    writer outside the static view — the LDT1403 finding renders pruned."""
+    config = _proto_config()
+    config.wire_witness = {
+        "frames": {"1": 6}, "fields": {"1": {"ghost": 4}},
+    }
+    findings = analyze(str(PROTO_FIXTURE_ROOT), config)
+    orphan = next(f for f in findings if f.rule == "LDT1403")
+    assert orphan.witness_pruned is True
+    assert "witness_pruned" in orphan.message
+
+
+def test_wire_witness_reproduces_dead_read():
+    """Message exercised, field never crossed: the orphan read upgrades
+    from inference to reproduced — and still fails the gate."""
+    config = _proto_config()
+    config.wire_witness = {"frames": {"1": 6}, "fields": {"1": {}}}
+    findings = analyze(str(PROTO_FIXTURE_ROOT), config)
+    orphan = next(f for f in findings if f.rule == "LDT1403")
+    assert orphan.witness_pruned is False
+    assert "reproduced dead read" in orphan.message
+
+
+def test_wire_witness_without_exercise_changes_nothing():
+    config = _proto_config()
+    config.wire_witness = {"frames": {"2": 9}, "fields": {}}
+    findings = analyze(str(PROTO_FIXTURE_ROOT), config)
+    orphan = next(f for f in findings if f.rule == "LDT1403")
+    assert orphan.witness_pruned is False
+    assert "witness" not in orphan.message
+
+
+def test_check_main_wire_witness_end_to_end(tmp_path):
+    pytest.importorskip("tomli")
+    wpath = tmp_path / "wire-witness.json"
+    wpath.write_text(json.dumps({
+        "version": 1,
+        "frames": {"1": 6},
+        "fields": {"1": {"ghost": 4, "payload_size": 6}},
+    }))
+    out = io.StringIO()
+    rc = check_main(
+        ["--root", str(PROTO_FIXTURE_ROOT), "--json", "--no-baseline",
+         "--wire-witness", str(wpath)],
+        out=out,
+    )
+    assert rc == 1  # the other seeds still fail the gate
+    data = json.loads(out.getvalue())
+    pruned = next(f for f in data["findings"] if f["rule"] == "LDT1403")
+    assert pruned["witness_pruned"] is True
+    assert pruned["rule_family"] == "wire-protocol"
+    # The corroboration receipt: both observed fields map onto the static
+    # schema (ghost is a known read, payload_size a known write+read).
+    assert data["wire_witness"] == {
+        "observed_fields": 2, "matched_fields": 2, "frames": 6,
+        "versions_seen": [],
+    }
+    assert "protocol" in data["model_build_ms"]
+
+
+def test_check_main_wire_witness_text_summary(tmp_path):
+    pytest.importorskip("tomli")
+    wpath = tmp_path / "wire-witness.json"
+    wpath.write_text(json.dumps({
+        "version": 1, "frames": {"1": 3},
+        "fields": {"1": {"payload_size": 3}},
+    }))
+    out = io.StringIO()
+    rc = check_main(
+        ["--root", str(PROTO_FIXTURE_ROOT), "--no-baseline",
+         "--wire-witness", str(wpath)],
+        out=out,
+    )
+    assert rc == 1
+    assert ("wire witness: 1/1 observed (msg, field) tuples match the "
+            "static schema over 3 frames") in out.getvalue()
+
+
+def test_check_main_unreadable_wire_witness_is_usage_error(tmp_path):
+    bad = tmp_path / "nope.json"
+    bad.write_text("{torn")
+    out = io.StringIO()
+    rc = check_main(
+        ["--root", str(PROTO_FIXTURE_ROOT), "--no-baseline",
+         "--wire-witness", str(bad)],
+        out=out,
+    )
+    assert rc == 2
+    assert "unreadable wire witness" in out.getvalue()
+
+
+def test_check_main_non_numeric_witness_key_is_usage_error(tmp_path):
+    """Message keys are numeric on the wire; a hand-edited witness with a
+    symbolic key must die at LOAD time (exit 2, diagnosable) — never as a
+    mid-analysis int() traceback inside the receipt."""
+    bad = tmp_path / "symbolic.json"
+    bad.write_text(json.dumps({
+        "version": 1, "frames": {"MSG_HELLO": 3},
+        "fields": {"MSG_HELLO": {"seed": 1}},
+    }))
+    out = io.StringIO()
+    rc = check_main(
+        ["--root", str(PROTO_FIXTURE_ROOT), "--no-baseline",
+         "--wire-witness", str(bad)],
+        out=out,
+    )
+    assert rc == 2
+    assert "unreadable wire witness" in out.getvalue()
+
+
+def test_wire_witness_versions_ride_the_receipt(tmp_path):
+    pytest.importorskip("tomli")
+    wpath = tmp_path / "wire-witness.json"
+    wpath.write_text(json.dumps({
+        "version": 1, "frames": {"1": 4},
+        "fields": {"1": {"payload_size": 4}},
+        "versions": {"1": [1, 3]},
+    }))
+    out = io.StringIO()
+    rc = check_main(
+        ["--root", str(PROTO_FIXTURE_ROOT), "--json", "--no-baseline",
+         "--wire-witness", str(wpath)],
+        out=out,
+    )
+    assert rc == 1
+    data = json.loads(out.getvalue())
+    assert data["wire_witness"]["versions_seen"] == [1, 3]
+    out = io.StringIO()
+    check_main(
+        ["--root", str(PROTO_FIXTURE_ROOT), "--no-baseline",
+         "--wire-witness", str(wpath)],
+        out=out,
+    )
+    assert "(versions seen: 1, 3)" in out.getvalue()
+
+
+def test_ldt1402_diamond_caller_graph_is_guarded(tmp_path):
+    """Regression: two guarded caller paths sharing an unguarded
+    intermediate must not be mistaken for an unguarded cycle — the memo
+    distinguishes a completed verdict from an on-path revisit."""
+    findings = _wire_rules(tmp_path, {
+        "reader.py": """\
+            import proto
+
+            def use(req):
+                return req.get("gadget")
+
+            def middle(req):
+                return use(req)
+
+            def path_a(sock):
+                msg_type, req = proto.recv_msg(sock)
+                if msg_type != proto.MSG_A:
+                    raise ValueError(msg_type)
+                if 3 < proto.GADGET_MIN_VERSION:
+                    raise ValueError()
+                return middle(req)
+
+            def path_b(sock):
+                msg_type, req = proto.recv_msg(sock)
+                if msg_type != proto.MSG_A:
+                    raise ValueError(msg_type)
+                if 3 < proto.GADGET_MIN_VERSION:
+                    raise ValueError()
+                return middle(req)
+        """,
+        "writer.py": """\
+            import proto
+
+            def send(sock):
+                proto.send_msg(sock, proto.MSG_A, {"gadget": 1})
+        """,
+    }, protocol_versions={"MSG_A.gadget": "GADGET_MIN_VERSION"})
+    assert findings == []
+
+
+def test_proto_model_is_shared_per_run(monkeypatch):
+    """One ProgramInfo parse pass, one ProtoModel build, shared by the
+    three LDT14xx whole-program rules in a run."""
+    import lance_distributed_training_tpu.analysis.protomodel as pm
+
+    calls = {"n": 0}
+    real_init = pm.ProtoModel.__init__
+
+    def counting_init(self, program, config):
+        calls["n"] += 1
+        real_init(self, program, config)
+
+    monkeypatch.setattr(pm.ProtoModel, "__init__", counting_init)
+    analyze(str(PROTO_FIXTURE_ROOT), _proto_config())
+    assert calls["n"] == 1
+
+
+def test_repo_protocol_schema_is_fully_paired():
+    """The repo self-check at field level: every payload field some peer
+    writes is read (or skew-checked) by the other side, and vice versa —
+    the machine-checked form of the hand-maintained HELLO contract."""
+    from lance_distributed_training_tpu.analysis.config import load_config
+    from lance_distributed_training_tpu.analysis.core import parse_modules
+    from lance_distributed_training_tpu.analysis.concmodel import (
+        build_program,
+    )
+    from lance_distributed_training_tpu.analysis.protomodel import (
+        build_proto_model,
+    )
+
+    config = load_config(str(REPO_ROOT))
+    modules, _, _ = parse_modules(str(REPO_ROOT), config)
+    model = build_proto_model(build_program(modules, config), config)
+    # Every HELLO field the model knows is covered by a server-side read:
+    # the decode_config_skew contract, now structural.
+    hello = model.messages["MSG_HELLO"]
+    assert set(hello.writes) == set(hello.reads)
+    for field in ("task_type", "image_size", "device_decode",
+                  "dataset_fingerprint", "stripe_index", "stripe_count"):
+        assert field in hello.reads, f"HELLO {field} lost its peer read"
+    assert model.orphan_writes() == []
+    assert model.orphan_reads() == []
+    assert model.ungated_sites == []
+
+
+# -- ldt graph --protocol -----------------------------------------------------
+
+
+def test_graph_protocol_text_smoke():
+    from lance_distributed_training_tpu.analysis import graph_main
+
+    out = io.StringIO()
+    rc = graph_main(["--root", str(REPO_ROOT), "--protocol"], out=out)
+    assert rc == 0
+    text = out.getvalue()
+    assert "protocol model:" in text
+    assert "msg MSG_HELLO:" in text
+    assert ">=STRIPE_MIN_VERSION" in text
+    assert "msg MSG_BATCH: binary payload" in text
+
+
+def test_graph_protocol_dot_smoke():
+    from lance_distributed_training_tpu.analysis import graph_main
+
+    out = io.StringIO()
+    rc = graph_main(
+        ["--root", str(PROTO_FIXTURE_ROOT), "pkg", "--dot", "--protocol"],
+        out=out,
+    )
+    assert rc == 0
+    dot = out.getvalue()
+    assert '"msg:MSG_PING"' in dot and "shape=hexagon" in dot
+
+
+def test_graph_protocol_cli_dispatch():
+    import lance_distributed_training_tpu.cli as cli
+
+    rc = cli.main(["graph", "--root", str(PROTO_FIXTURE_ROOT), "pkg",
+                   "--protocol"])
+    assert rc == 0
+
+
+def test_deleting_a_skew_check_fails_ldt1401_at_the_field():
+    """THE acceptance criterion: neuter one decode_config_skew read (the
+    device_decode check) in an in-memory copy of server.py and the model
+    must report the field as written-but-unchecked — at protocol.hello's
+    field line, with the real repo as every other module."""
+    from lance_distributed_training_tpu.analysis.config import load_config
+    from lance_distributed_training_tpu.analysis.core import (
+        ModuleInfo,
+        parse_modules,
+    )
+    from lance_distributed_training_tpu.analysis.concmodel import (
+        build_program,
+    )
+    from lance_distributed_training_tpu.analysis.protomodel import (
+        build_proto_model,
+    )
+
+    config = load_config(str(REPO_ROOT))
+    modules, _, _ = parse_modules(str(REPO_ROOT), config)
+    server = next(
+        m for m in modules if m.relpath.endswith("service/server.py")
+    )
+    mutated_src = server.source.replace(
+        'dd = req.get("device_decode")', "dd = None"
+    )
+    assert mutated_src != server.source  # the check exists to be deleted
+    mutated = ModuleInfo(server.root, server.relpath, mutated_src)
+    modules = [mutated if m is server else m for m in modules]
+    model = build_proto_model(build_program(modules, config), config)
+    orphans = {(s.msg, s.field) for s in model.orphan_writes()}
+    assert ("MSG_HELLO", "device_decode") in orphans
+    site = next(
+        s for s in model.orphan_writes() if s.field == "device_decode"
+    )
+    # Reported at the field's write site in the schema owner — the
+    # protocol module's hello() constructor.
+    assert site.module.endswith("service/protocol.py")

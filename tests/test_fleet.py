@@ -532,6 +532,124 @@ def test_serve_cli_coordinator_flags():
     assert args.coordinator is None
 
 
+def test_malformed_heartbeat_generation_rejected_without_refresh(
+    image_dataset, fleet
+):
+    """A wrong-typed heartbeat field answers a diagnosable MSG_ERROR and
+    must NOT refresh the member's liveness clock — the hello_malformed
+    discipline, applied to the control plane."""
+    coordinator, servers = fleet
+    server_id = servers[0].fleet_agent.server_id
+    with coordinator._lock:
+        before = coordinator._members[server_id].last_heartbeat
+    msg_type, reply = coordinator._handle_heartbeat({
+        "server_id": server_id, "generation": "abc",
+    })
+    assert msg_type == P.MSG_ERROR
+    assert "malformed heartbeat field 'generation'" in reply["message"]
+    with coordinator._lock:
+        member = coordinator._members[server_id]
+        # The reject path never reached the liveness refresh (the live
+        # agent may have heartbeated concurrently, which only moves the
+        # clock FORWARD — equality-or-later still proves the malformed
+        # frame itself refreshed nothing, and acked_generation keeps its
+        # well-typed value).
+        assert member.last_heartbeat >= before
+        assert isinstance(member.acked_generation, int)
+    # A well-typed heartbeat still works.
+    msg_type, reply = coordinator._handle_heartbeat({
+        "server_id": server_id, "generation": coordinator.generation,
+    })
+    assert msg_type == P.MSG_FLEET_HEARTBEAT_OK
+    with coordinator._lock:
+        acked = coordinator._members[server_id].acked_generation
+    assert acked == coordinator.generation
+    # A generation-less heartbeat (minimal foreign peer) keeps the last
+    # known value instead of fabricating a permanent generation-0
+    # stuck-lease signal on /healthz.
+    msg_type, _ = coordinator._handle_heartbeat({"server_id": server_id})
+    assert msg_type == P.MSG_FLEET_HEARTBEAT_OK
+    with coordinator._lock:
+        assert coordinator._members[server_id].acked_generation >= acked
+
+
+def test_missing_stripe_echo_is_fatal():
+    """A v3-claiming server that DROPS the stripe echo must be rejected:
+    defaulting a missing echo to the requested values would pass exactly
+    the mis-striping server the check exists to catch (it would serve
+    every step — silent fleet-wide duplication)."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+
+    def echo_dropping_server():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                _, req = P.recv_msg(conn)
+                P.send_msg(conn, P.MSG_HELLO_OK, {
+                    "version": 3, "num_steps": 7,
+                    "start_step": int(req.get("start_step", 0)),
+                })
+            finally:
+                conn.close()
+
+    threading.Thread(target=echo_dropping_server, daemon=True).start()
+    try:
+        loader = FleetLoader("127.0.0.1:1", 16, 0, 1,
+                             connect_retries=1, backoff_s=0.01,
+                             timeout_s=5.0)
+        with pytest.raises(P.ProtocolError, match="residue class"):
+            loader._dial_member(f"127.0.0.1:{port}", 0, 1, 2, None)
+    finally:
+        srv.close()
+
+
+def test_restripe_stays_v3_and_bit_identical(image_dataset, fleet):
+    """Cross-version satellite: a mid-epoch restripe (the autotune
+    stripe-width move — failover's cursor-preserving mechanics) opens its
+    new round with full-version v3 HELLOs, never a downgraded offer (the
+    FleetLoader's no-downgrade policy is sticky across rounds), and the
+    merged stream stays bit-identical through the round boundary."""
+    coordinator, servers = fleet
+    hellos = []
+    for svc in servers:
+        orig = svc.decode_config_skew
+
+        def capture(req, _orig=orig):
+            hellos.append((
+                req["version"], req["stripe_count"], bool(req.get("probe")),
+            ))
+            return _orig(req)
+
+        svc.decode_config_skew = capture
+    local = _local_batches(image_dataset)
+    loader = _fleet_loader(coordinator)
+    got = []
+    it = iter(loader)
+    for _ in range(3):
+        got.append(next(it))
+    loader.set_stripe_width(1)  # end the round at the cursor, re-stripe
+    for batch in it:
+        got.append(batch)
+    assert len(got) == len(local)
+    for a, b in zip(got, local):
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+    assert loader.counters.snapshot().get("fleet_restripes", 0) >= 1
+    # Every HELLO across both rounds offered the current version — a
+    # restripe must never downgrade-offer (a pre-v3 peer would serve every
+    # step: silent duplication).
+    assert hellos and all(v == P.PROTOCOL_VERSION for v, _c, _p in hellos)
+    stream_counts = {c for _v, c, probe in hellos if not probe}
+    assert {2, 1} <= stream_counts  # round 1 striped 2-wide, round 2 1-wide
+
+
 @pytest.mark.slow
 def test_train_through_fleet(image_dataset):
     """Full trainer integration: train() with coordinator_addr streams every

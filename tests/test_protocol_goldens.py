@@ -1,0 +1,294 @@
+"""Golden wire corpus + wire sanitizer: the cross-version compatibility
+gate. Every checked-in frame blob must decode with the current build and
+re-encode byte-identically per protocol version; legacy (v1) frames the
+current constructors can no longer produce must still be ACCEPTED by a
+live server; and the opt-in wire recorder (``LDT_WIRE_SANITIZER=1``) must
+capture the (msg, field) traffic the LDT1403 witness cross-check feeds
+on."""
+
+import io
+import json
+import socket
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from lance_distributed_training_tpu.service import goldens as G
+from lance_distributed_training_tpu.service import protocol as P
+from lance_distributed_training_tpu.service import DataService, ServeConfig
+from lance_distributed_training_tpu.utils import wiretrack
+
+pytestmark = pytest.mark.fast
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+GOLDEN_DIR = REPO_ROOT / "tests" / "goldens" / "protocol"
+
+
+# -- the checked-in corpus ---------------------------------------------------
+
+
+def test_checked_in_corpus_round_trips():
+    """THE gate: current encoders reproduce every blob, every blob decodes
+    and re-encodes byte-identically, the manifest hashes match."""
+    assert G.verify_goldens(str(GOLDEN_DIR)) == []
+
+
+def test_corpus_covers_every_version_and_wire_message():
+    versions = {s.version for s in G.GOLDEN_SPECS}
+    assert versions == {1, 2, 3}
+    covered = {s.msg for s in G.GOLDEN_SPECS}
+    wire_msgs = {n for n in dir(P) if n.startswith("MSG_")}
+    assert covered == wire_msgs, (
+        "every protocol message needs at least one golden frame"
+    )
+
+
+def test_batch_golden_decodes_bit_identically():
+    data = (GOLDEN_DIR / "v1_batch_pixels.bin").read_bytes()
+    _type, payload = G._split_frame(data)
+    step, batch, lineage = P.decode_batch(payload, with_lineage=True)
+    assert step == 4 and lineage is None
+    expected = G._golden_tensors()
+    assert set(batch) == set(expected)
+    for key in expected:
+        np.testing.assert_array_equal(batch[key], expected[key])
+
+
+def test_coeff_batch_golden_carries_device_decode_schema():
+    data = (GOLDEN_DIR / "v3_batch_coeff.bin").read_bytes()
+    _type, payload = G._split_frame(data)
+    _step, batch, lineage = P.decode_batch(payload, with_lineage=True)
+    assert lineage == G._GOLDEN_LINEAGE
+    assert {"jpeg_coef_y", "jpeg_coef_cb", "jpeg_coef_cr",
+            "jpeg_quant", "jpeg_geom"} <= set(batch)
+    assert batch["jpeg_coef_y"].dtype == np.int16
+
+
+def test_version_mismatch_marker_is_pinned_by_a_golden():
+    """Rewording VERSION_MISMATCH_MARKER (or a server's rejection prose)
+    breaks this golden before it breaks new-client -> old-server interop."""
+    data = (GOLDEN_DIR / "v1_error_version_mismatch.bin").read_bytes()
+    _type, payload = G._split_frame(data)
+    msg = json.loads(bytes(payload))
+    assert P.VERSION_MISMATCH_MARKER in msg["message"]
+
+
+# -- corruption / drift detection --------------------------------------------
+
+
+def test_corrupted_blob_fails_verify(tmp_path):
+    G.write_goldens(str(tmp_path))
+    blob = tmp_path / "v1_ack.bin"
+    data = bytearray(blob.read_bytes())
+    data[-1] ^= 0xFF
+    blob.write_bytes(bytes(data))
+    errors = G.verify_goldens(str(tmp_path))
+    assert any("v1_ack" in e and "sha256" in e for e in errors)
+
+
+def test_encoder_drift_fails_verify(tmp_path, monkeypatch):
+    """The build-identity half: change what the constructor emits and the
+    gate names the exact golden + version that moved."""
+    G.write_goldens(str(tmp_path))
+    real_hello = P.hello
+
+    def drifted_hello(**kwargs):
+        msg = real_hello(**kwargs)
+        msg["surprise"] = 1  # a field merged without touching the corpus
+        return msg
+
+    monkeypatch.setattr(P, "hello", drifted_hello)
+    errors = G.verify_goldens(str(tmp_path))
+    assert any(
+        "v3_hello_full" in e and "different bytes" in e for e in errors
+    )
+    # Legacy frames are frozen literals — constructor drift cannot touch
+    # them, so the v1 bare HELLO stays green.
+    assert not any("v1_hello_bare" in e for e in errors)
+
+
+def test_missing_manifest_is_a_loud_failure(tmp_path):
+    errors = G.verify_goldens(str(tmp_path))
+    assert errors and "--update" in errors[0]
+
+
+def test_goldens_cli_verify_update_cycle(tmp_path):
+    out = io.StringIO()
+    assert G.goldens_main(
+        ["goldens", "--dir", str(tmp_path)], out=out
+    ) == 1  # nothing there yet
+    out = io.StringIO()
+    assert G.goldens_main(
+        ["goldens", "--update", "--dir", str(tmp_path)], out=out
+    ) == 0
+    assert "wrote" in out.getvalue()
+    out = io.StringIO()
+    assert G.goldens_main(
+        ["goldens", "--dir", str(tmp_path)], out=out
+    ) == 0
+    assert "round-trip byte-identically" in out.getvalue()
+
+
+def test_goldens_cli_dispatches_through_ldt():
+    from lance_distributed_training_tpu.cli import main
+
+    rc = main(["protocol", "goldens", "--dir", str(GOLDEN_DIR)])
+    assert rc == 0
+
+
+# -- cross-version acceptance by a live server --------------------------------
+
+
+def test_golden_hellos_accepted_by_live_server(image_dataset):
+    """Replaying the checked-in HELLO bytes — including the v1 frame no
+    current constructor can produce — against a real DataService must
+    yield HELLO_OK: the corpus is the deployed-peer population."""
+    svc = DataService(ServeConfig(
+        dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+        image_size=32, queue_depth=2,
+    )).start()
+    try:
+        for name, expect in (
+            ("v1_hello_bare", {"version": 1, "start_step": 0}),
+            ("v2_hello", {"version": 2}),
+            ("v3_hello_full", {"version": 3}),
+            ("v3_hello_striped", {
+                "version": 3, "start_step": 8,
+                "stripe_index": 1, "stripe_count": 4,
+            }),
+            ("v3_hello_fingerprint", None),  # fingerprint skew: rejected
+        ):
+            data = (GOLDEN_DIR / f"{name}.bin").read_bytes()
+            sock = socket.create_connection(
+                ("127.0.0.1", svc.port), timeout=5
+            )
+            try:
+                sock.sendall(data)
+                msg_type, msg = P.recv_msg(sock)
+                if expect is None:
+                    # The golden declares a fixed fingerprint this test
+                    # dataset cannot match — the skew check must fire,
+                    # which is itself the acceptance (the field reaches
+                    # decode_config_skew across versions).
+                    assert msg_type == P.MSG_ERROR, (name, msg)
+                    assert "dataset skew" in msg["message"]
+                else:
+                    assert msg_type == P.MSG_HELLO_OK, (name, msg)
+                    for key, value in expect.items():
+                        assert msg.get(key) == value, (name, key, msg)
+            finally:
+                sock.close()
+    finally:
+        svc.stop()
+
+
+# -- runtime wire sanitizer (utils/wiretrack.py) ------------------------------
+
+
+@pytest.fixture()
+def wiretrack_sandbox():
+    """Snapshot/restore the recorder around tests that enable or reset it
+    (a sanitizer-enabled tier-1 session collects its witness ACROSS the
+    suite — same discipline as lockorder/leaktrack sandboxes)."""
+    saved = wiretrack.snapshot()
+    wiretrack.disable()
+    wiretrack.reset()
+    try:
+        yield wiretrack
+    finally:
+        wiretrack.restore(saved)
+
+
+def test_wiretrack_records_control_traffic(wiretrack_sandbox):
+    wiretrack.enable()
+    a, b = socket.socketpair()
+    try:
+        P.send_msg(a, P.MSG_ACK, {"step": 3})
+        P.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+    # Both directions record: 1 send + 1 receive.
+    assert wiretrack.frames()[P.MSG_ACK] == 2
+    assert wiretrack.fields()[P.MSG_ACK]["step"] == 2
+
+
+def test_wiretrack_records_hello_version(wiretrack_sandbox):
+    wiretrack.enable()
+    a, b = socket.socketpair()
+    try:
+        P.send_msg(a, P.MSG_HELLO, P.hello(
+            batch_size=4, process_index=0, process_count=1, version=2,
+        ))
+        reader = P.FrameReader(b)
+        msg_type, msg = reader.recv_msg()
+        assert msg_type == P.MSG_HELLO and msg["version"] == 2
+    finally:
+        a.close()
+        b.close()
+    snap = wiretrack.snapshot()
+    assert 2 in snap["versions"][P.MSG_HELLO]
+    assert wiretrack.fields()[P.MSG_HELLO]["stripe_index"] == 2
+
+
+def test_wiretrack_batch_frames_count_frames_only(wiretrack_sandbox):
+    wiretrack.enable()
+    a, b = socket.socketpair()
+    try:
+        payload = P.encode_batch(
+            0, {"x": np.ones((2, 2), np.float32)}
+        )
+        P.send_frame(a, P.MSG_BATCH, payload)
+        msg_type, _ = P.recv_msg(b)
+        assert msg_type == P.MSG_BATCH
+    finally:
+        a.close()
+        b.close()
+    assert wiretrack.frames()[P.MSG_BATCH] == 1  # receive side only
+    assert P.MSG_BATCH not in wiretrack.fields()
+
+
+def test_golden_encodes_never_feed_the_wire_witness(wiretrack_sandbox):
+    """A ByteSink is not a wire: building the corpus under the sanitizer
+    must record NOTHING — otherwise legacy golden literals would count as
+    observed traffic and falsely prune LDT1403 dead reads in CI."""
+    wiretrack.enable()
+    for spec in G.GOLDEN_SPECS:
+        G.build_golden(spec)
+    assert wiretrack.frames() == {}
+    assert wiretrack.fields() == {}
+
+
+def test_wiretrack_off_records_nothing(wiretrack_sandbox):
+    a, b = socket.socketpair()
+    try:
+        P.send_msg(a, P.MSG_END, {})
+        P.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+    assert wiretrack.frames() == {}
+
+
+def test_wiretrack_dump_roundtrips_through_witness_loader(
+    wiretrack_sandbox, tmp_path
+):
+    from lance_distributed_training_tpu.analysis.cli import (
+        load_wire_witness,
+    )
+
+    wiretrack.enable()
+    wiretrack.record_frame(P.MSG_HELLO, {"version": 3, "batch_size": 8})
+    wiretrack.record_frame(P.MSG_HELLO, {"version": 1})
+    wiretrack.record_frame(P.MSG_BATCH, None)
+    path = wiretrack.dump(str(tmp_path / "wire-witness.json"))
+    witness = load_wire_witness(path)
+    assert witness["frames"][str(P.MSG_HELLO)] == 2
+    assert witness["frames"][str(P.MSG_BATCH)] == 1
+    assert witness["fields"][str(P.MSG_HELLO)] == {
+        "version": 2, "batch_size": 1,
+    }
+    assert witness["versions"][str(P.MSG_HELLO)] == [1, 3]
+    raw = json.loads(Path(path).read_text())
+    assert raw["versions"][str(P.MSG_HELLO)] == [1, 3]

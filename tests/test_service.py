@@ -311,6 +311,165 @@ def test_v2_client_downgrades_to_v1_server():
         srv.close()
 
 
+@pytest.mark.parametrize("version", [1, 2, 3])
+def test_cross_version_client_matrix(image_dataset, service, version):
+    """The full interop matrix against the current server: a client forced
+    to each protocol version must receive the bit-identical batch stream —
+    versions change envelope features (lineage, striping), never content —
+    with lineage present exactly when the negotiated version carries it."""
+    local = list(make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1,
+        ImageClassificationDecoder(image_size=32),
+    ))
+    loader = _loader(service)
+    loader._hello_version = version
+    got = list(loader)
+    assert len(got) == len(local)
+    for a, b in zip(got, local):
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+    if version >= P.LINEAGE_MIN_VERSION:
+        assert len(loader.recent_lineage) == len(local)
+    else:
+        assert len(loader.recent_lineage) == 0
+
+
+def test_hello_ok_start_step_echo_validated():
+    """The client must reject a HELLO_OK whose start_step echo disagrees
+    with its request — the stream would silently begin at the wrong step
+    and every later resume cursor would be off by the difference."""
+    import threading
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+
+    def desynced_server():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                _, req = P.recv_msg(conn)
+                P.send_msg(conn, P.MSG_HELLO_OK, {
+                    "version": req["version"], "num_steps": 7,
+                    "start_step": int(req["start_step"]) + 1,  # off by one
+                })
+            finally:
+                conn.close()
+
+    threading.Thread(target=desynced_server, daemon=True).start()
+    try:
+        loader = RemoteLoader(f"127.0.0.1:{port}", 16, 0, 1,
+                              connect_retries=1, backoff_s=0.01,
+                              timeout_s=5.0)
+        with pytest.raises(P.ProtocolError, match="start_step"):
+            len(loader)
+    finally:
+        srv.close()
+
+
+def test_hello_ok_garbage_start_step_echo_is_protocol_error():
+    """A non-integer echo must be the diagnosable ProtocolError, never a
+    raw ValueError escaping the connect path (the handler-killing-repr
+    class hello_malformed fixes server-side)."""
+    import threading
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+
+    def garbage_server():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                _, req = P.recv_msg(conn)
+                P.send_msg(conn, P.MSG_HELLO_OK, {
+                    "version": req["version"], "num_steps": 7,
+                    "start_step": "zero",
+                })
+            finally:
+                conn.close()
+
+    threading.Thread(target=garbage_server, daemon=True).start()
+    try:
+        loader = RemoteLoader(f"127.0.0.1:{port}", 16, 0, 1,
+                              connect_retries=1, backoff_s=0.01,
+                              timeout_s=5.0)
+        with pytest.raises(P.ProtocolError, match="start_step"):
+            len(loader)
+    finally:
+        srv.close()
+
+
+@pytest.mark.parametrize("field,bad", [
+    ("batch_size", "16"),
+    ("process_index", "0"),
+    ("process_count", True),  # JSON true is not an integer count
+    ("seed", "7"),
+    ("epoch", [1]),
+    ("start_step", "zero"),
+    ("stripe_index", 1.5),
+    ("stripe_count", "4"),
+    ("image_size", "abc"),
+    ("sampler_type", 3),
+    ("client_id", 9),
+    ("task_type", 7),
+    ("dataset_fingerprint", 123),
+    ("shuffle", "yes"),
+    ("probe", 1),
+    ("device_decode", "true"),
+    ("columns", "image"),
+])
+def test_malformed_hello_field_answers_skew_style_error(
+    image_dataset, service, field, bad
+):
+    """Satellite: a HELLO field of the wrong TYPE must be rejected with a
+    diagnosable MSG_ERROR at connect time — before this, a non-numeric
+    image_size reached ``int(size)`` inside decode_config_skew and killed
+    the handler thread with a ValueError repr."""
+    sock = socket.create_connection(("127.0.0.1", service.port), timeout=5)
+    try:
+        req = P.hello(batch_size=16, process_index=0, process_count=1)
+        req[field] = bad
+        P.send_msg(sock, P.MSG_HELLO, req)
+        msg_type, msg = P.recv_msg(sock)
+        assert msg_type == P.MSG_ERROR
+        assert "malformed HELLO field" in msg["message"]
+        assert repr(field) in msg["message"]
+    finally:
+        sock.close()
+    # The handler thread answered and moved on — the server still serves
+    # (a probe handshake is the cheap liveness check).
+    assert len(_loader(service)) == 240 // 16
+    assert service.counters.snapshot().get(
+        "svc_proto_malformed_hello", 0
+    ) >= 1
+
+
+def test_well_typed_hello_passes_malformed_check():
+    """The validator accepts every shape our own constructors emit —
+    including all-None optional fields and the v1 bare dict."""
+    assert P.hello_malformed(P.hello(
+        batch_size=16, process_index=0, process_count=1,
+    )) is None
+    assert P.hello_malformed(P.hello(
+        batch_size=16, process_index=0, process_count=1,
+        stripe_index=1, stripe_count=4, task_type="classification",
+        image_size=224, device_decode=True, dataset_fingerprint="ab" * 16,
+        columns=["image", "label"],
+    )) is None
+    assert P.hello_malformed({"version": 1, "batch_size": 8}) is None
+
+
 def test_v1_server_hello_ok_accepted():
     """Range check on the server's echoed version: v1 is in-range, an
     out-of-range or garbage version is a hard skew."""
