@@ -17,6 +17,7 @@ from . import (  # noqa: F401  (import for registration side effect)
     lockorder,
     obs,
     ownership,
+    padding,
     persistence,
     placement,
     protocol,

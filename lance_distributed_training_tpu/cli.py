@@ -109,6 +109,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="force the host pixel-decode path — the exact "
                          "r11 pipeline, the A/B control arm for "
                          "--device_decode (this is also the default)")
+    tp = p.add_mutually_exclusive_group()
+    tp.add_argument("--token_pack", action="store_true",
+                    help="ragged token plane (text tasks): variable-length "
+                         "sequences ride the pipeline as values+offsets "
+                         "pages with a deterministic first-fit-decreasing "
+                         "pack plan; a pure jitted kernel scatters them "
+                         "into packed (rows, pack_len) slabs with segment-"
+                         "masked attention ahead of the step — padding "
+                         "waste becomes a measured, autotuned quantity "
+                         "(pad_waste_pct on /metrics)")
+    tp.add_argument("--no_token_pack", action="store_true",
+                    help="force the padded token path — the exact r14 "
+                         "control arm for --token_pack (this is also the "
+                         "default)")
+    p.add_argument("--pack_len", type=int, default=0,
+                   help="packed slot-length cap (0 = --seq_len); a bounded "
+                        "autotuner Tunable")
+    p.add_argument("--pack_rows_multiple", type=int, default=8,
+                   help="packed row-count rounding quantum: smaller = less "
+                        "padding waste, more distinct compiled shapes (the "
+                        "autotuner trades these live)")
     p.add_argument("--data_service", type=str, default=None, metavar="HOST:PORT",
                    help="stream decoded batches from a running `ldt "
                         "serve-data` service instead of decoding locally "
@@ -321,6 +342,19 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "(entropy-only host decode) instead of finished "
                         "pixels — trainers must also run --device_decode "
                         "(the HELLO is skew-checked); classification only")
+    p.add_argument("--token_pack", action="store_true",
+                   help="serve packed variable-length token batches "
+                        "(values/offsets pages + pack plan; text tasks) to "
+                        "v4 clients that request --token_pack; every other "
+                        "peer still streams the bit-identical padded arm")
+    p.add_argument("--seq_len", type=int, default=128,
+                   help="padded sequence length for text tasks (must match "
+                        "the trainer's --seq_len; decode config, like "
+                        "--image_size)")
+    p.add_argument("--pack_len", type=int, default=0,
+                   help="packed slot-length cap (0 = --seq_len)")
+    p.add_argument("--pack_rows_multiple", type=int, default=8,
+                   help="packed row-count rounding quantum")
     p.add_argument("--batch_cache", action="store_true",
                    help="epoch-coherent decoded-batch cache (tiered "
                         "RAM/disk): a second epoch, a reconnected "
@@ -505,6 +539,10 @@ def serve_main(argv=None) -> dict:
         shm_workers=not args.no_shm_workers,
         buffer_pool=not args.no_buffer_pool,
         device_decode=args.device_decode,
+        token_pack=args.token_pack,
+        seq_len=args.seq_len,
+        pack_len=args.pack_len,
+        pack_rows_multiple=args.pack_rows_multiple,
         batch_cache=args.batch_cache,
         cache_ram_budget_mb=args.cache_ram_budget_mb,
         cache_disk_budget_mb=args.cache_disk_budget_mb,
@@ -662,6 +700,9 @@ def main(argv=None) -> dict:
         shm_workers=not args.no_shm_workers,
         buffer_pool=not args.no_buffer_pool,
         device_decode=args.device_decode and not args.no_device_decode,
+        token_pack=args.token_pack and not args.no_token_pack,
+        pack_len=args.pack_len,
+        pack_rows_multiple=args.pack_rows_multiple,
         data_service_addr=args.data_service,
         coordinator_addr=args.coordinator,
         no_ddp=args.no_ddp,

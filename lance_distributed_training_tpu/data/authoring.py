@@ -27,6 +27,7 @@ __all__ = [
     "create_synthetic_image_folder",
     "create_synthetic_image_text_dataset",
     "create_text_token_dataset",
+    "create_variable_length_token_dataset",
     "ingest_on_process_zero",
     "IMAGE_SCHEMA",
 ]
@@ -465,6 +466,65 @@ def create_text_token_dataset(
     )
 
 
+def create_variable_length_token_dataset(
+    output_path: str,
+    rows: int,
+    vocab_size: int = 1000,
+    max_len: int = 128,
+    mean_len: float = 24.0,
+    sigma: float = 0.7,
+    fragment_size: int = 50000,
+    seed: int = 0,
+    include_mask: bool = False,
+) -> Dataset:
+    """Variable-length token corpus — the ragged token plane's test/bench
+    dataset (no real tokenizer needed).
+
+    Schema: ``{input_ids: list_<int32>}`` (plus an all-ones variable
+    ``attention_mask`` list column with ``include_mask=True`` — packed
+    decoding regenerates the mask on device, so the default schema skips
+    it). Row lengths draw from a seeded **clipped lognormal** — the
+    long-tail shape real tokenized text shows (most sequences far below
+    the max, a heavy tail touching it), which is exactly the distribution
+    where dataset-max padding burns the most FLOPs: with the defaults
+    (mean ~24, max 128) a fixed-shape loader pads ~80% dead tokens.
+    Everything is a pure function of ``seed`` — two hosts authoring the
+    same arguments produce byte-identical datasets (the
+    :func:`~.format.Dataset.fingerprint` skew check depends on it).
+    """
+    rng = np.random.default_rng(seed)
+    schema_fields = [("input_ids", pa.list_(pa.int32()))]
+    if include_mask:
+        schema_fields.append(("attention_mask", pa.list_(pa.int8())))
+    schema = pa.schema(schema_fields)
+
+    def gen() -> Iterator[pa.RecordBatch]:
+        done = 0
+        while done < rows:
+            n = min(4096, rows - done)
+            lengths = np.clip(
+                rng.lognormal(np.log(mean_len), sigma, n).astype(np.int64),
+                1, max_len,
+            )
+            ids = [
+                rng.integers(2, vocab_size, int(L), dtype=np.int32)
+                for L in lengths
+            ]
+            arrays = [pa.array(ids, schema.field("input_ids").type)]
+            if include_mask:
+                arrays.append(pa.array(
+                    [np.ones(int(L), np.int8) for L in lengths],
+                    schema.field("attention_mask").type,
+                ))
+            yield pa.record_batch(arrays, schema=schema)
+            done += n
+
+    return write_dataset(
+        gen(), output_path, schema=schema, mode="overwrite",
+        max_rows_per_file=fragment_size,
+    )
+
+
 def main(argv=None) -> None:
     """Dataset-authoring CLI — the ``create_datasets/classification.py``
     script equivalent (``/root/reference/create_datasets/classification.py:
@@ -494,6 +554,18 @@ def main(argv=None) -> None:
     synth.add_argument("--image_size", type=int, default=224)
     synth.add_argument("--fragment_size", type=int, default=12500)
 
+    tokens = sub.add_parser(
+        "tokens", help="variable-length synthetic token dataset (long-tail "
+                       "lengths; the ragged token plane's corpus)"
+    )
+    tokens.add_argument("--output_path", required=True)
+    tokens.add_argument("--rows", type=int, required=True)
+    tokens.add_argument("--vocab_size", type=int, default=1000)
+    tokens.add_argument("--max_len", type=int, default=128)
+    tokens.add_argument("--mean_len", type=float, default=24.0)
+    tokens.add_argument("--seed", type=int, default=0)
+    tokens.add_argument("--fragment_size", type=int, default=50000)
+
     food = sub.add_parser(
         "food101", help="food-101 archive/tree → train + test datasets"
     )
@@ -507,6 +579,12 @@ def main(argv=None) -> None:
         create_synthetic_classification_dataset(
             args.output_path, args.rows, num_classes=args.num_classes,
             image_size=args.image_size, fragment_size=args.fragment_size,
+        )
+    elif args.kind == "tokens":
+        create_variable_length_token_dataset(
+            args.output_path, args.rows, vocab_size=args.vocab_size,
+            max_len=args.max_len, mean_len=args.mean_len, seed=args.seed,
+            fragment_size=args.fragment_size,
         )
     elif args.kind == "food101":
         create_food101_datasets(

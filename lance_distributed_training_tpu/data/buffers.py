@@ -57,7 +57,7 @@ import threading
 import time
 import uuid
 import weakref
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -66,11 +66,25 @@ from ..utils import leaktrack
 
 __all__ = [
     "BufferPool",
+    "RaggedPage",
     "default_buffer_pool",
     "ShmRing",
     "ShmSlotWriter",
     "shm_available",
 ]
+
+
+class RaggedPage(NamedTuple):
+    """One variable-length column's pooled pages: a flat ``values`` page
+    sized to a capacity *bucket* (so batches of nearby token counts recycle
+    the same physical pages) and an exact ``offsets`` page. Both are
+    ordinary pool leases — ``release``/``release_batch`` on the arrays (the
+    consumer's existing discipline) reclaims them; there is no separate
+    ragged release verb to forget."""
+
+    values: np.ndarray  # [capacity_bucket] — caller fills [:total]
+    offsets: np.ndarray  # int32 [n_sequences + 1]
+    capacity: int  # the bucket the values page was keyed under
 
 # 64-byte alignment for tensor offsets inside a shm slot (cache-line; also
 # satisfies every numpy dtype's alignment requirement).
@@ -127,6 +141,8 @@ class BufferPool:
         self._evicts = reg.counter("bufpool_evict_total")
         self._in_use = reg.gauge("bufpool_in_use")
         self._pending_gauge = reg.gauge("bufpool_pending")
+        self._ragged_leases = reg.counter("bufpool_ragged_leases_total")
+        self._ragged_slack = reg.counter("bufpool_ragged_slack_bytes_total")
 
     @staticmethod
     def _key(shape, dtype) -> Tuple:
@@ -195,15 +211,69 @@ class BufferPool:
             leaktrack.track_acquire("pool-page", id(arr), depth=3)
         return arr
 
+    def lease_ragged(self, total: int, n_sequences: int,
+                     values_dtype) -> RaggedPage:
+        """Lease one variable-length column's page pair (see
+        :class:`RaggedPage`). The values page is keyed by its **capacity
+        bucket** (next power of two ≥ ``total``), not the exact token
+        count — without the bucket, every distinct batch token total would
+        mint its own free-list key and the pool would never recycle a
+        ragged page (the fragmentation the r15 tentpole removes). Both
+        pages ride the ordinary lease/release discipline — the LDT1201
+        ownership analyzer and the ``LDT_LEAK_SANITIZER`` witness track
+        them through the same ``BufferPool.lease`` acquire site."""
+        from .token_pack import ragged_capacity
+
+        cap = ragged_capacity(int(total))
+        values = self.lease((cap,), values_dtype)
+        try:
+            offsets = self.lease((int(n_sequences) + 1,), np.int32)
+        except BaseException:
+            # The pair acquires atomically or not at all: a failed offsets
+            # lease must not strand the values page (LDT1201's
+            # exception-edge class).
+            self.release(values)
+            raise
+        try:
+            # Counted only once BOTH pages are held — a MemoryError'd lease
+            # must not inflate the ragged series exactly in the degraded
+            # runs where an operator reads them.
+            self._ragged_leases.inc()
+            self._ragged_slack.inc(
+                (cap - int(total)) * np.dtype(values_dtype).itemsize
+            )
+        except BaseException:
+            self.release(values)
+            self.release(offsets)
+            raise
+        return RaggedPage(values, offsets, cap)
+
     def release(self, arr) -> bool:
         """Return a leased page. ``False`` (and a no-op) for arrays this
-        pool does not own — safe to call on every value of a mixed batch."""
+        pool does not own — safe to call on every value of a mixed batch.
+        A *view* of a leased page (a ragged values page sliced to its real
+        token count) releases its base: the refcount sweep still defers
+        recycling until every view dies, so this is always safe."""
         if not isinstance(arr, np.ndarray):
             return False
         with self._lock:
             ref = self._outstanding.pop(id(arr), None)
             if ref is None or ref() is not arr:  # foreign (or id reuse race)
-                return False
+                # Walk the view chain: releasing batch["c__values"][:n]
+                # must find the pooled base page it windows.
+                base = arr.base
+                hops = 0
+                while isinstance(base, np.ndarray) and hops < 4:
+                    ref = self._outstanding.pop(id(base), None)
+                    if ref is not None and ref() is base:
+                        arr = base
+                        break
+                    base = base.base
+                    hops += 1
+                else:
+                    return False
+                if ref is None or ref() is not arr:
+                    return False
             self._in_use.set(len(self._outstanding))
             self._pending.append(arr)
             self._sweep_locked()

@@ -263,15 +263,35 @@ def decode_tensor_image(
 class ImageTextDecoder:
     """Mixed-modal collate: JPEG bytes + packed token columns → one batch dict
     (the BASELINE "LAION-subset image+caption → CLIP" config). Images via the
-    native/PIL path, token columns zero-copy via :func:`numeric_decoder`."""
+    native/PIL path, token columns zero-copy via :func:`numeric_decoder` —
+    or, with ``token_pack``/``seq_len``, the ragged plane's
+    :class:`~.token_pack.TokenDecoder` in **bucket** mode: one sequence per
+    slot (caption i stays paired with image i), slot length bucketed to the
+    batch max instead of padded to the dataset max."""
 
     def __init__(self, image_size: int = 224, image_column: str = "image",
-                 buffer_pool=None):
+                 buffer_pool=None, token_pack=None,
+                 seq_len: Optional[int] = None):
         self._image = ImageClassificationDecoder(
             image_size=image_size, image_column=image_column,
             label_column=None, buffer_pool=buffer_pool,
         )
         self.image_column = image_column
+        self._text = None
+        if token_pack is not None or seq_len is not None:
+            from .token_pack import TokenDecoder, TokenPackPlanner
+
+            if token_pack is not None:
+                self._text = TokenDecoder(
+                    mode="bucket",
+                    seq_len=seq_len or token_pack.pack_len,
+                    planner=TokenPackPlanner(token_pack),
+                    buffer_pool=buffer_pool,
+                    pad_id=token_pack.pad_id,
+                )
+            else:
+                self._text = TokenDecoder(mode="pad", seq_len=seq_len,
+                                          buffer_pool=buffer_pool)
 
     @property
     def buffer_pool(self):
@@ -280,9 +300,20 @@ class ImageTextDecoder:
     @buffer_pool.setter
     def buffer_pool(self, pool) -> None:
         self._image.buffer_pool = pool
+        if self._text is not None:
+            self._text.buffer_pool = pool
 
     def cache_fingerprint(self) -> str:
-        return f"ImageTextDecoder/{self._image.cache_fingerprint()}"
+        text = (
+            self._text.cache_fingerprint() if self._text is not None
+            else "numeric"
+        )
+        return f"ImageTextDecoder/{self._image.cache_fingerprint()}/{text}"
+
+    def tunables(self):
+        if self._text is None:
+            return []
+        return self._text.tunables()
 
     def __call__(
         self, batch: Union[pa.RecordBatch, pa.Table]
@@ -292,7 +323,8 @@ class ImageTextDecoder:
             if isinstance(batch, pa.RecordBatch)
             else batch
         )
-        out = numeric_decoder(table.drop_columns([self.image_column]))
+        text_fn = self._text if self._text is not None else numeric_decoder
+        out = text_fn(table.drop_columns([self.image_column]))
         out["image"] = self._image.decode_column(
             table.column(self.image_column)
         )
@@ -301,7 +333,8 @@ class ImageTextDecoder:
 
 
 def decoder_for_task(task_type: str, image_size: int = 224,
-                     buffer_pool=None, device_decode: bool = False):
+                     buffer_pool=None, device_decode: bool = False,
+                     token_pack=None, seq_len: Optional[int] = None):
     """THE task-type → decode-hook dispatch, shared by the trainer and the
     data-service server. Keeping it in one place is what upholds the
     service's bit-identical-batches guarantee: a decoder change that only
@@ -314,7 +347,17 @@ def decoder_for_task(task_type: str, image_size: int = 224,
     (:mod:`.device_decode`): the host emits half-decoded coefficient pages
     and the dense back half runs as the jitted device kernel
     (:mod:`..ops.jpeg_device`) — classification only; degrades to the
-    pixel path with one warning when the native extractor is absent."""
+    pixel path with one warning when the native extractor is absent.
+
+    The text tasks' ragged plane (r15, :mod:`.token_pack`): ``token_pack``
+    (a :class:`~.token_pack.TokenPackConfig`) selects the ragged emit —
+    variable-length columns ship as values+offsets pages plus a
+    deterministic FFD pack plan, finished by the device kernel
+    (:mod:`..ops.token_device`). With ``seq_len`` alone the padded
+    :class:`~.token_pack.TokenDecoder` control arm runs (variable columns
+    pad to ``seq_len`` — the exact pre-ragged stream); with neither, the
+    plain :func:`numeric_decoder` keeps its historical fixed-size-only
+    contract."""
     if task_type == "classification":
         if device_decode:
             from .device_decode import coeff_decoder_or_fallback
@@ -331,24 +374,74 @@ def decoder_for_task(task_type: str, image_size: int = 224,
             f"only (the JPEG entropy split), got {task_type!r}"
         )
     if task_type in ("masked_lm", "causal_lm"):
+        if token_pack is not None or seq_len is not None:
+            from .token_pack import TokenDecoder, TokenPackPlanner
+
+            if token_pack is not None:
+                return TokenDecoder(
+                    mode="pack",
+                    seq_len=seq_len or token_pack.pack_len,
+                    planner=TokenPackPlanner(token_pack),
+                    buffer_pool=buffer_pool,
+                    pad_id=token_pack.pad_id,
+                )
+            return TokenDecoder(mode="pad", seq_len=seq_len,
+                                buffer_pool=buffer_pool)
         return numeric_decoder  # zero-copy Arrow→numpy: nothing to pool
     if task_type == "contrastive":
         return ImageTextDecoder(image_size=image_size,
-                                buffer_pool=buffer_pool)
+                                buffer_pool=buffer_pool,
+                                token_pack=token_pack, seq_len=seq_len)
     raise ValueError(f"Invalid task type: {task_type}")
 
 
 def numeric_decoder(batch: Union[pa.RecordBatch, pa.Table]) -> dict[str, np.ndarray]:
     """Decode all-numeric columnar batches (text-token / tabular datasets):
-    each column straight to numpy, fixed-size list columns to 2-D arrays."""
+    each column straight to numpy, fixed-size list columns to 2-D arrays.
+
+    Zero-copy (the r15 silent-copy fix): a null-free primitive buffer is
+    viewed with one ``np.frombuffer`` window instead of the
+    ``to_numpy(zero_copy_only=False)`` path, which memcpys even when the
+    buffer is directly addressable; fallbacks are counted on the LDT701
+    copy-hygiene rows (``decode_token_bytes_total`` /
+    ``decode_token_copies_total``). Variable-length list columns pad to
+    the *batch* max (shape varies batch to batch) — static-shape training
+    goes through :class:`~.token_pack.TokenDecoder` instead."""
+    from .token_pack import (
+        _token_copy_metrics,
+        fill_padded,
+        list_column_parts,
+        primitive_view,
+    )
+
     out: dict[str, np.ndarray] = {}
     table = pa.Table.from_batches([batch]) if isinstance(batch, pa.RecordBatch) else batch
+    tok_bytes, tok_copies = _token_copy_metrics()
     for name in table.column_names:
         col = table.column(name).combine_chunks()
         if pa.types.is_fixed_size_list(col.type):
             flat = col.chunk(0) if isinstance(col, pa.ChunkedArray) else col
-            values = flat.values.to_numpy(zero_copy_only=False)
+            values, copied = primitive_view(flat.values)
+            tok_bytes.inc(values.nbytes)
+            if copied:
+                tok_copies.inc(values.nbytes)
             out[name] = values.reshape(len(flat), col.type.list_size)
+        elif pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
+            values, offsets, copied = list_column_parts(col)
+            tok_bytes.inc(values.nbytes)
+            if copied:
+                tok_copies.inc(values.nbytes)
+            lengths = offsets[1:] - offsets[:-1]
+            width = int(lengths.max()) if len(lengths) else 0
+            page = np.zeros((len(lengths), width), values.dtype)
+            fill_padded(page, values, offsets, lengths)
+            out[name] = page
         else:
-            out[name] = col.to_numpy(zero_copy_only=False)
+            values, copied = primitive_view(
+                col.chunk(0) if isinstance(col, pa.ChunkedArray) else col
+            )
+            tok_bytes.inc(values.nbytes)
+            if copied:
+                tok_copies.inc(values.nbytes)
+            out[name] = values
     return out

@@ -220,11 +220,45 @@ class PlacementPlane:
             tuple(gshape), sharding, shards
         )
 
+    def _place_replicated(self, x):
+        """Ragged token leaves (flat values pages, offsets, pack plans —
+        no per-row leading dim to split over the data axis): replicate.
+        The pack kernel consumes them whole; its packed output re-enters
+        the data layout inside the jitted transform."""
+        x = np.asarray(x)
+        cached = self._shardings.get("repl")
+        if cached is None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            cached = (
+                NamedSharding(self.mesh, PartitionSpec()),
+                jax.process_count(),
+            )
+            self._shardings["repl"] = cached
+        sharding, nproc = cached
+        if nproc == 1:
+            return device_put(x, sharding)
+        return make_array_from_process_local_data(sharding, x)
+
     def place_batch(self, host_batch):
         """One host batch (pytree of numpy arrays) → global ``jax.Array``
         pytree, per-device transfers dispatched asynchronously. Bit-identical
         to ``make_global_batch(host_batch, mesh)`` — pinned by
-        ``tests/test_placement.py``."""
+        ``tests/test_placement.py``. Dict batches are key-aware for the
+        ragged token convention: ``_host_*`` metadata passes through as
+        numpy (read host-side by the pack transform), ragged leaves
+        replicate, everything else shards over the data axis as always."""
+        if isinstance(host_batch, dict):
+            from .token_pack import is_host_meta_key, is_ragged_key
+
+            return {
+                k: (
+                    np.asarray(v) if is_host_meta_key(k)
+                    else self._place_replicated(v) if is_ragged_key(k)
+                    else self._place_leaf(v)
+                )
+                for k, v in host_batch.items()
+            }
         return jax.tree_util.tree_map(self._place_leaf, host_batch)
 
     def _release(self, host_batch) -> None:

@@ -1,0 +1,661 @@
+"""Ragged token plane — variable-length sequences as first-class citizens.
+
+Before r15 the text half of the pipeline was fixed-shape only:
+``numeric_decoder`` accepted *fixed-size-list* token columns, so every
+LM/contrastive batch was padded to the dataset-wide max length before it
+ever reached the pool, the wire, or the device — pure FLOP and bandwidth
+waste that grows with sequence-length variance (MinatoLoader, PAPERS.md
+2509.10712, is the reference for keeping preprocessing overlapped when
+per-item cost varies). This module is the host half of the fix:
+
+* **Ragged batch convention** — a variable-length column ``c`` rides every
+  plane (pool, shm ring, wire, cache, placement) as two plain numpy
+  tensors: ``c__values`` (flat int32 tokens, zero-padded to a capacity
+  *bucket* so the BufferPool recycles pages across batches instead of
+  fragmenting per exact length) and ``c__offsets`` (int32 ``[B+1]`` row
+  boundaries). A batch-level pack *plan* (``_pack_slot``/``_pack_start``
+  per sequence + the small ``_host_pack_meta`` header) rides along; the
+  device kernel (:mod:`..ops.token_device`) scatters the runs into packed
+  ``(rows, L)`` slabs with ``segment_ids``/``position_ids``.
+* **:class:`TokenPackPlanner`** — deterministic length-bucketed
+  first-fit-decreasing packing, a pure function of
+  ``(lengths, pack_len, rows_multiple)``: no clocks, no RNG, no iteration
+  over unordered containers (a declared LDT1301 content-path), so the
+  plan is cache-keyable (the r13 ``cache_fingerprint`` contract) and the
+  packed stream is bit-identical across runs and resumes.
+* **:class:`TokenDecoder`** — the decode hook for the text tasks, three
+  modes: ``"pad"`` (the exact r14 control arm: pad to ``seq_len``, the one
+  legitimate home of the full-``max_len`` allocation LDT1501 bans from
+  every other hot path), ``"pack"`` (FFD multi-sequence slots — masked/
+  causal LM), and ``"bucket"`` (one sequence per slot, slot length bucketed
+  to the batch max — contrastive, where row i must stay paired with
+  image i).
+
+Padding waste is a measured quantity in every mode: the decoder counts
+``pack_payload_tokens_total`` (real tokens) against
+``pack_grid_tokens_total`` (the token grid the device will actually
+process), so ``pad_waste_pct``/``pack_occupancy`` ride /metrics and the
+autotuner (``tune/``) can trade the pack knobs' recompile count against
+padding waste live.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+__all__ = [
+    "VALUES_SUFFIX",
+    "OFFSETS_SUFFIX",
+    "PACK_SLOT_KEY",
+    "PACK_START_KEY",
+    "PACK_META_KEY",
+    "HOST_META_PREFIX",
+    "PACK_MODE_FFD",
+    "PACK_MODE_BUCKET",
+    "is_ragged_key",
+    "is_host_meta_key",
+    "is_ragged_batch",
+    "ragged_bases",
+    "ragged_capacity",
+    "length_bucket",
+    "PackPlan",
+    "TokenPackConfig",
+    "TokenPackPlanner",
+    "TokenDecoder",
+    "primitive_view",
+    "list_column_parts",
+]
+
+# Ragged-column key convention (shared with ops/token_device.py, the
+# placement plane, and the wire's batch-meta "ragged" field).
+VALUES_SUFFIX = "__values"
+OFFSETS_SUFFIX = "__offsets"
+PACK_SLOT_KEY = "_pack_slot"
+PACK_START_KEY = "_pack_start"
+# Host-side metadata: keys with this prefix are never device_put — the
+# placement plane and make_global_batch pass them through as numpy, so the
+# pack transform can read (rows, pack_len) without a device sync.
+HOST_META_PREFIX = "_host_"
+PACK_META_KEY = "_host_pack_meta"  # int32 [4]: rows, pack_len, payload, mode
+
+PACK_MODE_FFD = 0  # multi-sequence slots + segment/position ids
+PACK_MODE_BUCKET = 1  # one sequence per slot (row-preserving; contrastive)
+
+_PLAN_KEYS = (PACK_SLOT_KEY, PACK_START_KEY, PACK_META_KEY)
+
+
+def is_ragged_key(name: str) -> bool:
+    """Is this batch key part of the ragged convention (values/offsets/plan)?
+    Such leaves are replicated — never sharded along the data axis — by the
+    placement plane: a flat token run has no per-row leading dim to split."""
+    return (
+        name.endswith(VALUES_SUFFIX)
+        or name.endswith(OFFSETS_SUFFIX)
+        or name in (PACK_SLOT_KEY, PACK_START_KEY)
+    )
+
+
+def is_host_meta_key(name: str) -> bool:
+    """Host-passthrough keys: stay numpy through placement (no device_put)."""
+    return name.startswith(HOST_META_PREFIX)
+
+
+def is_ragged_batch(batch: dict) -> bool:
+    return isinstance(batch, dict) and PACK_META_KEY in batch
+
+
+def ragged_bases(batch: dict) -> List[str]:
+    """Base column names carried ragged in ``batch``, sorted (deterministic
+    iteration — dict order is insertion order, but the kernel loop must not
+    depend on who built the dict)."""
+    return sorted(
+        k[: -len(VALUES_SUFFIX)]
+        for k in batch
+        if k.endswith(VALUES_SUFFIX)
+    )
+
+
+def ragged_capacity(n: int, floor: int = 256) -> int:
+    """Values-page capacity bucket for ``n`` flat tokens: next power of two
+    ≥ max(n, floor). Bucketing is what keeps the BufferPool's key space
+    small — variable batches recycle the same few page sizes instead of
+    fragmenting the free lists per exact token count."""
+    cap = max(int(n), floor, 1)
+    return 1 << (cap - 1).bit_length()
+
+
+def length_bucket(n: int, lo: int = 32, hi: int = 1 << 20) -> int:
+    """Slot-length bucket: next power of two ≥ n, clamped to [lo, hi]. The
+    L_bucket ladder — a handful of distinct compiled shapes instead of one
+    per batch max."""
+    n = max(int(n), 1)
+    edge = max(lo, 1 << (n - 1).bit_length())
+    return min(edge, hi)
+
+
+# -- metrics -----------------------------------------------------------------
+
+
+def _pack_metrics():
+    """The padding-waste observability rows (process registry, /metrics):
+    ``pack_payload_tokens_total`` vs ``pack_grid_tokens_total`` is the live
+    ``pad_waste_pct`` the autotuner acts on; emitted by EVERY decode mode
+    (the padded control arm included) so the packed-vs-padded waste cut is
+    scrapeable, not inferred. Looked up lazily so decoders stay picklable
+    across worker processes."""
+    from ..obs.registry import default_registry
+
+    reg = default_registry()
+    return (
+        reg.counter("pack_payload_tokens_total"),
+        reg.counter("pack_grid_tokens_total"),
+        reg.counter("pack_sequences_total"),
+        reg.counter("pack_truncated_tokens_total"),
+        reg.counter("pack_batches_total"),
+    )
+
+
+def _token_copy_metrics():
+    """LDT701-adjacent copy-hygiene rows for the token path:
+    ``decode_token_bytes_total`` (token bytes leaving decode) and
+    ``decode_token_copies_total`` (bytes that had to be memcpy'd because a
+    zero-copy Arrow view wasn't possible — nulls, chunked remainders, or
+    non-primitive storage)."""
+    from ..obs.registry import default_registry
+
+    reg = default_registry()
+    return (
+        reg.counter("decode_token_bytes_total"),
+        reg.counter("decode_token_copies_total"),
+    )
+
+
+# -- zero-copy Arrow views ---------------------------------------------------
+
+
+def primitive_view(arr: pa.Array) -> Tuple[np.ndarray, bool]:
+    """A primitive Arrow array → ``(numpy view, copied)``.
+
+    ``to_numpy(zero_copy_only=False)`` on this path always memcpys (it goes
+    through the pandas-conversion machinery even for a plain contiguous
+    buffer) — the silent-copy the r15 satellite removes. When the array is
+    null-free primitive storage, the data buffer is directly addressable:
+    one ``np.frombuffer`` over the Arrow buffer, offset-sliced, zero bytes
+    moved. Fallback (nulls present, exotic types) copies and says so."""
+    t = arr.type
+    if arr.null_count == 0 and (
+        pa.types.is_integer(t) or pa.types.is_floating(t)
+    ):
+        buf = arr.buffers()[1]
+        if buf is not None:
+            dtype = np.dtype(t.to_pandas_dtype())
+            view = np.frombuffer(buf, dtype=dtype,
+                                 count=arr.offset + len(arr))
+            return view[arr.offset:], False
+    return arr.to_numpy(zero_copy_only=False), True
+
+
+def fill_padded(page: np.ndarray, values: np.ndarray, offsets: np.ndarray,
+                lengths: np.ndarray) -> None:
+    """Fill a pre-allocated ``[n, width]`` page with each row's (possibly
+    truncated) token run — THE pad-fill loop, shared by the padded control
+    arm and :func:`~.decode.numeric_decoder`'s batch-max path so the two
+    can never drift (truncation, dtype, and accounting live once)."""
+    for i in range(len(lengths)):
+        L = int(lengths[i])
+        page[i, :L] = values[int(offsets[i]):int(offsets[i]) + L]
+
+
+def list_column_parts(col) -> Tuple[np.ndarray, np.ndarray, bool]:
+    """A (large_)list column → ``(flat_values_view, offsets [B+1] int64,
+    copied)``, offsets rebased to start at 0. Values are a zero-copy window
+    over the child buffer whenever the storage allows."""
+    if isinstance(col, pa.ChunkedArray):
+        col = col.combine_chunks()
+    raw_offsets, off_copied = primitive_view(col.offsets)
+    offsets = raw_offsets.astype(np.int64)  # small [B+1]; dtype-normalised
+    values, val_copied = primitive_view(col.values)
+    lo, hi = int(offsets[0]), int(offsets[-1])
+    return values[lo:hi], offsets - lo, (off_copied or val_copied)
+
+
+# -- the planner -------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PackPlan:
+    """One batch's packing decision — a pure function of (lengths, config).
+
+    ``slot[i]``/``start[i]`` place sequence ``i``'s (possibly truncated)
+    token run at ``grid[slot[i], start[i] : start[i] + len_i]``;
+    ``rows × pack_len`` is the packed grid shape (rows rounded up to the
+    planner's ``rows_multiple`` so the jit cache sees a short ladder of
+    shapes, not one per batch)."""
+
+    slot: np.ndarray  # int32 [n]
+    start: np.ndarray  # int32 [n]
+    rows: int
+    pack_len: int
+    payload_tokens: int  # real tokens placed (post-truncation)
+    truncated_tokens: int  # tokens dropped by the pack_len cap
+
+    @property
+    def grid_tokens(self) -> int:
+        return self.rows * self.pack_len
+
+    def meta(self, mode: int) -> np.ndarray:
+        """The ``_host_pack_meta`` header the batch carries."""
+        return np.array(
+            [self.rows, self.pack_len, self.payload_tokens, int(mode)],
+            dtype=np.int32,
+        )
+
+
+@dataclasses.dataclass
+class TokenPackConfig:
+    """Pack knobs. ``pack_len`` caps the slot length (and is the padded
+    arm's static sequence length); ``rows_multiple`` is the slot-count
+    rounding quantum — smaller = less padding waste but more distinct
+    compiled shapes (the trade the autotune policy rung moves along).
+    ``len_bucket_lo`` floors the L_bucket ladder. ``rows_align`` is a
+    HARD divisibility floor on the packed row count (the trainer sets it
+    to the mesh's data-axis size so every packed grid shards over the
+    devices) — applied after the quantum rounding, immune to autotune
+    moves of ``rows_multiple``, and part of the fingerprint (it changes
+    the packed layout)."""
+
+    pack_len: int = 128
+    rows_multiple: int = 8
+    len_bucket_lo: int = 32
+    pad_id: int = 0
+    rows_align: int = 1
+
+    def fingerprint(self) -> str:
+        return (
+            f"ffd/{self.pack_len}/{self.rows_multiple}/"
+            f"{self.len_bucket_lo}/{self.pad_id}/{self.rows_align}"
+        )
+
+
+class TokenPackPlanner:
+    """Deterministic first-fit-decreasing sequence packing.
+
+    ``plan(lengths)`` is a pure function of its argument and the config
+    (LDT1301 content-path: no clocks, no RNG, no queue/set iteration) —
+    identical lengths always yield the identical plan, which is what makes
+    a resumed mid-epoch stream replay the exact packed batches the
+    uninterrupted run produced.
+    """
+
+    def __init__(self, config: Optional[TokenPackConfig] = None):
+        self.config = config if config is not None else TokenPackConfig()
+
+    def fingerprint(self) -> str:
+        return self.config.fingerprint()
+
+    # -- autotune actuators (capacity-style: they move the packed LAYOUT,
+    # never the sequence content or order) --
+
+    def set_pack_len(self, value: int) -> int:
+        value = max(8, int(value))
+        self.config.pack_len = value
+        return value
+
+    def set_rows_multiple(self, value: int) -> int:
+        value = max(1, int(value))
+        self.config.rows_multiple = value
+        return value
+
+    def tunables(self):
+        from ..tune.tunable import Tunable
+
+        cfg = self.config
+        out = []
+        if cfg.pack_len > 8:
+            out.append(Tunable(
+                "pack_len",
+                lambda: self.config.pack_len,
+                self.set_pack_len,
+                lo=8, hi=max(cfg.pack_len, 16),
+                doc="packed slot length cap (tokens per packed row)",
+            ))
+        out.append(Tunable(
+            "pack_rows_quantum",
+            lambda: self.config.rows_multiple,
+            self.set_rows_multiple,
+            lo=1, hi=64,
+            doc="packed row-count rounding quantum: smaller = less padding "
+                "waste, more distinct compiled shapes",
+        ))
+        return out
+
+    # -- the pure planning functions --
+
+    def plan(self, lengths: Sequence[int]) -> PackPlan:
+        """FFD packing of ``lengths`` into slots of the bucketed length.
+
+        Sequences are placed longest-first (ties broken by original index —
+        a total, deterministic order); each lands in the first open slot
+        with room, opening a new slot when none fits. Over-long sequences
+        are truncated to the slot length (counted, never silently)."""
+        cfg = self.config
+        n = len(lengths)
+        arr = np.asarray(lengths, dtype=np.int64)
+        if n == 0:
+            return PackPlan(
+                np.zeros(0, np.int32), np.zeros(0, np.int32),
+                rows=max(1, cfg.rows_multiple), pack_len=cfg.len_bucket_lo,
+                payload_tokens=0, truncated_tokens=0,
+            )
+        pack_len = length_bucket(
+            int(arr.max()), lo=cfg.len_bucket_lo, hi=max(cfg.pack_len, 8)
+        )
+        clipped = np.minimum(arr, pack_len)
+        truncated = int((arr - clipped).sum())
+        # Stable longest-first order: sort by (-length, index).
+        order = np.lexsort((np.arange(n), -clipped))
+        slot = np.zeros(n, np.int32)
+        start = np.zeros(n, np.int32)
+        fill: List[int] = []  # per-open-slot used length
+        for i in order:
+            length = int(clipped[i])
+            placed = -1
+            for s, used in enumerate(fill):
+                if used + length <= pack_len:
+                    placed = s
+                    break
+            if placed < 0:
+                placed = len(fill)
+                fill.append(0)
+            slot[i] = placed
+            start[i] = fill[placed]
+            fill[placed] += length
+        rows = -(-max(len(fill), 1) // cfg.rows_multiple) * cfg.rows_multiple
+        align = max(1, cfg.rows_align)
+        rows = -(-rows // align) * align  # device-divisibility floor
+        return PackPlan(slot, start, rows=rows, pack_len=pack_len,
+                        payload_tokens=int(clipped.sum()),
+                        truncated_tokens=truncated)
+
+    def plan_bucket(self, lengths: Sequence[int]) -> PackPlan:
+        """Row-preserving variant: sequence ``i`` occupies slot ``i`` whole
+        (contrastive — row i must stay paired with image i); the win is the
+        slot length bucketing to the batch max instead of the dataset max."""
+        cfg = self.config
+        n = len(lengths)
+        arr = np.asarray(lengths, dtype=np.int64)
+        pack_len = length_bucket(
+            int(arr.max()) if n else 1,
+            lo=cfg.len_bucket_lo, hi=max(cfg.pack_len, 8),
+        )
+        clipped = np.minimum(arr, pack_len)
+        return PackPlan(
+            np.arange(n, dtype=np.int32), np.zeros(n, np.int32),
+            rows=max(n, 1), pack_len=pack_len,
+            payload_tokens=int(clipped.sum()),
+            truncated_tokens=int((arr - clipped).sum()),
+        )
+
+
+# -- the decode hook ---------------------------------------------------------
+
+
+class TokenDecoder:
+    """Arrow token batches → host tensors, ragged-aware.
+
+    Modes
+    -----
+    ``"pad"``
+        The r14 control arm: variable-length list columns pad to
+        ``seq_len`` (``attention_mask`` synthesised when the schema lacks
+        one); fixed-size-list columns take the new zero-copy 2-D view.
+        This is the ONE hot-path home of the full-``max_len`` allocation
+        (LDT1501 bans it everywhere else).
+    ``"pack"``
+        Emit the ragged convention + an FFD :class:`PackPlan`; the device
+        kernel finishes the job. An all-fixed-size batch degrades to the
+        pad path (packing fixed rows is a no-op).
+    ``"bucket"``
+        Row-preserving ragged emit (contrastive text columns).
+
+    Every mode feeds the ``pack_*`` waste counters, so the padded and
+    packed arms are compared on live /metrics, not by assumption.
+    """
+
+    def __init__(
+        self,
+        mode: str = "pad",
+        seq_len: int = 128,
+        planner: Optional[TokenPackPlanner] = None,
+        buffer_pool=None,
+        pad_id: int = 0,
+    ):
+        if mode not in ("pad", "pack", "bucket"):
+            raise ValueError(f"invalid TokenDecoder mode: {mode!r}")
+        self.mode = mode
+        self.seq_len = int(seq_len)
+        self.planner = (
+            planner if planner is not None
+            else TokenPackPlanner(TokenPackConfig(pack_len=self.seq_len))
+        )
+        self.buffer_pool = buffer_pool
+        self.pad_id = int(pad_id)
+
+    def cache_fingerprint(self) -> str:
+        """Batch-cache identity (r13 contract): everything that can change
+        the bytes this decoder emits — mode, the padded length, and the
+        FULL pack-plan config, so a live bucket-edge/pack_len move re-scopes
+        later cache entries instead of aliasing differently-packed bytes."""
+        return (
+            f"TokenDecoder/{self.mode}/{self.seq_len}/{self.pad_id}/"
+            f"{self.planner.fingerprint()}"
+        )
+
+    def tunables(self):
+        """Autotune registration surface — forwarded by the pipelines'
+        ``tunables()`` exactly like the device-decode coeff_chunk knob."""
+        if self.mode == "pad":
+            return []
+        return self.planner.tunables()
+
+    # Picklable for worker processes (mirror ImageClassificationDecoder:
+    # the pool is process-local, workers re-bind their own or run unpooled).
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["buffer_pool"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+    # -- leases ------------------------------------------------------------
+
+    def _lease(self, shape, dtype) -> np.ndarray:
+        if self.buffer_pool is None:
+            return np.empty(tuple(shape), np.dtype(dtype))
+        return self.buffer_pool.lease(shape, dtype)
+
+    # -- the hook ----------------------------------------------------------
+
+    def __call__(self, batch) -> Dict[str, np.ndarray]:
+        table = (
+            pa.Table.from_batches([batch])
+            if isinstance(batch, pa.RecordBatch) else batch
+        )
+        fixed: Dict[str, np.ndarray] = {}
+        ragged: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        tok_bytes, tok_copies = _token_copy_metrics()
+        for name in table.column_names:
+            col = table.column(name).combine_chunks()
+            if pa.types.is_fixed_size_list(col.type):
+                flat = col.chunk(0) if isinstance(col, pa.ChunkedArray) \
+                    else col
+                values, copied = primitive_view(flat.values)
+                tok_bytes.inc(values.nbytes)
+                if copied:
+                    tok_copies.inc(values.nbytes)
+                fixed[name] = values.reshape(len(flat), col.type.list_size)
+            elif pa.types.is_list(col.type) or pa.types.is_large_list(
+                col.type
+            ):
+                values, offsets, copied = list_column_parts(col)
+                tok_bytes.inc(values.nbytes)
+                if copied:
+                    tok_copies.inc(values.nbytes)
+                ragged[name] = (values, offsets)
+            else:
+                values, copied = primitive_view(
+                    col.chunk(0) if isinstance(col, pa.ChunkedArray) else col
+                )
+                fixed[name] = values
+        if not ragged:
+            # Fixed-shape dataset: nothing to pack/pad; still account the
+            # grid so pad_waste_pct reads honestly (mask-weighted when the
+            # schema carries one).
+            self._count_fixed(fixed)
+            return fixed
+        if self.mode == "pad":
+            return self._emit_padded(fixed, ragged)
+        return self._emit_ragged(fixed, ragged)
+
+    # -- accounting --------------------------------------------------------
+
+    def _count_fixed(self, out: Dict[str, np.ndarray]) -> None:
+        ids = out.get("input_ids")
+        if ids is None or ids.ndim != 2:
+            return
+        payload, grid, seqs, _trunc, batches = _pack_metrics()
+        mask = out.get("attention_mask")
+        real = int(np.count_nonzero(mask)) if mask is not None \
+            else int(ids.size)
+        payload.inc(real)
+        grid.inc(int(ids.size))
+        seqs.inc(int(ids.shape[0]))
+        batches.inc()
+
+    # -- padded (control) arm ----------------------------------------------
+
+    def _emit_padded(self, fixed, ragged) -> Dict[str, np.ndarray]:
+        """Pad every ragged column to ``seq_len`` — the exact pre-ragged
+        stream shape (``create_text_token_dataset(pack=False)`` parity).
+        The full-max_len allocations below are the ones LDT1501 exempts:
+        this module is padding's single legitimate home."""
+        out = dict(fixed)
+        payload, grid, seqs, trunc, batches = _pack_metrics()
+        lengths = None
+        base_offsets = None
+        total_real = 0
+        for name, (values, offsets) in sorted(ragged.items()):
+            n = len(offsets) - 1
+            col_lengths = np.minimum(
+                offsets[1:] - offsets[:-1], self.seq_len
+            )
+            if lengths is None:
+                lengths = col_lengths
+                base_offsets = offsets
+            elif not np.array_equal(offsets, base_offsets):
+                # Same contract as the packed arm: ONE length vector must
+                # describe every ragged column, or the synthesized
+                # attention_mask below would mark the wrong positions
+                # valid for the columns it wasn't derived from.
+                raise ValueError(
+                    f"ragged column {name!r} has different row lengths "
+                    "than its siblings — the padded arm synthesizes one "
+                    "attention_mask for the whole batch"
+                )
+            page = self._lease((n, self.seq_len), values.dtype)
+            # Park the lease in the batch dict BEFORE filling: the
+            # consumer's release_batch reclaims it on every path,
+            # exception edges included (LDT1201 discipline).
+            out[name] = page
+            page[...] = self.pad_id
+            fill_padded(page, values, offsets, col_lengths)
+            total_real += int(col_lengths.sum())
+            trunc.inc(int((offsets[1:] - offsets[:-1] - col_lengths).sum()))
+        if "attention_mask" not in out and lengths is not None:
+            mask = self._lease((len(lengths), self.seq_len), np.int8)
+            out["attention_mask"] = mask  # parked pre-fill, as above
+            mask[...] = (
+                np.arange(self.seq_len)[None, :] < lengths[:, None]
+            )
+        if lengths is not None:
+            payload.inc(int(lengths.sum()))
+            grid.inc(len(lengths) * self.seq_len * len(ragged))
+            # Grid counts every padded token column (the device processes
+            # each); payload mirrors it so occupancy compares like to like.
+            payload.inc(total_real - int(lengths.sum()))
+            seqs.inc(len(lengths))
+            batches.inc()
+        return out
+
+    # -- ragged (packed) arm -----------------------------------------------
+
+    def _emit_ragged(self, fixed, ragged) -> Dict[str, np.ndarray]:
+        # The regenerated device-side mask supersedes a stored one: an
+        # all-ones variable-length attention_mask column packed alongside
+        # input_ids would double the wire bytes for zero information.
+        ragged.pop("attention_mask", None)
+        if not ragged:
+            return self._emit_padded(fixed, {})
+        if self.mode == "pack" and fixed:
+            extra = sorted(fixed)
+            raise ValueError(
+                "token_pack (FFD) reorders sequences into packed slots and "
+                f"cannot carry per-row fixed columns {extra} alongside "
+                "ragged ones; use bucket mode (row-preserving) for paired "
+                "modalities"
+            )
+        out: Dict[str, np.ndarray] = dict(fixed)
+        payload, grid, seqs, trunc, batches = _pack_metrics()
+        plan: Optional[PackPlan] = None
+        base_offsets: Optional[np.ndarray] = None
+        for name, (values, offsets) in sorted(ragged.items()):
+            if base_offsets is None:
+                base_offsets = offsets
+                lengths = offsets[1:] - offsets[:-1]
+                plan = (
+                    self.planner.plan(lengths)
+                    if self.mode == "pack"
+                    else self.planner.plan_bucket(lengths)
+                )
+            elif not np.array_equal(offsets, base_offsets):
+                raise ValueError(
+                    f"ragged column {name!r} has different row lengths "
+                    "than its siblings — one pack plan must place every "
+                    "ragged column"
+                )
+            total = int(offsets[-1])
+            cap = ragged_capacity(total)
+            if self.buffer_pool is not None:
+                page = self.buffer_pool.lease_ragged(
+                    total, len(offsets) - 1, values.dtype
+                )
+                # Park both pages in the batch dict FIRST (ownership
+                # transfer — the consumer's release_batch reclaims them on
+                # every path, LDT1201's exception-edge discipline).
+                out[name + VALUES_SUFFIX] = page.values
+                out[name + OFFSETS_SUFFIX] = page.offsets
+                vpage, opage = page.values, page.offsets
+            else:
+                vpage = np.empty((cap,), values.dtype)
+                opage = np.empty((len(offsets),), np.int32)
+                out[name + VALUES_SUFFIX] = vpage
+                out[name + OFFSETS_SUFFIX] = opage
+            np.copyto(vpage[:total], values)
+            vpage[total:] = 0  # deterministic tail: digests stay stable
+            np.copyto(opage, offsets.astype(np.int32))
+        assert plan is not None
+        out[PACK_SLOT_KEY] = plan.slot
+        out[PACK_START_KEY] = plan.start
+        mode = PACK_MODE_FFD if self.mode == "pack" else PACK_MODE_BUCKET
+        out[PACK_META_KEY] = plan.meta(mode)
+        payload.inc(plan.payload_tokens * len(ragged))
+        grid.inc(plan.grid_tokens * len(ragged))
+        seqs.inc(len(plan.slot))
+        trunc.inc(plan.truncated_tokens * len(ragged))
+        batches.inc()
+        return out

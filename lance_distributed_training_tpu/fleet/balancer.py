@@ -338,7 +338,9 @@ class FleetLoader:
         timeout_s: float = 120.0,
         task_type: Optional[str] = None,
         image_size: Optional[int] = None,
+        seq_len: Optional[int] = None,
         device_decode: Optional[bool] = None,
+        token_pack: Optional[bool] = None,
         dataset_fingerprint: Optional[str] = None,
         registry: Optional[MetricsRegistry] = None,
         buffer_pool=None,
@@ -364,7 +366,12 @@ class FleetLoader:
         self.timeout_s = timeout_s
         self.task_type = task_type
         self.image_size = image_size
+        self.seq_len = seq_len
         self.device_decode = device_decode
+        # Ragged token plane (v4+): like striping, packing is not
+        # downgrade-safe — every dialed member must speak
+        # TOKEN_PACK_MIN_VERSION (checked next to the stripe floor).
+        self.token_pack = token_pack
         # Declared dataset identity (see RemoteLoader): every member of
         # the fleet must serve the SAME dataset content — a stale-mirror
         # member is rejected at its handshake, not silently striped in.
@@ -554,7 +561,9 @@ class FleetLoader:
             probe=probe,
             task_type=self.task_type,
             image_size=self.image_size,
+            seq_len=self.seq_len,
             device_decode=self.device_decode,
+            token_pack=self.token_pack,
             dataset_fingerprint=self.dataset_fingerprint,
         )
 
@@ -606,6 +615,19 @@ class FleetLoader:
                             f"{P.STRIPE_MIN_VERSION} "
                             "(no stripe support) — upgrade it before "
                             "fleeting"
+                        )
+                    # Packing shares striping's no-downgrade rule: a
+                    # member that cannot speak the ragged plane would
+                    # silently stripe PADDED rows into a packed stream.
+                    if self.token_pack and int(
+                        reply.get("version", 0)
+                    ) < P.TOKEN_PACK_MIN_VERSION:
+                        raise P.ProtocolError(
+                            f"data server {addr} speaks protocol "
+                            f"{reply.get('version')} < "
+                            f"{P.TOKEN_PACK_MIN_VERSION} (no token_pack "
+                            "support) — upgrade it or train with "
+                            "--no_token_pack"
                         )
                     # Stripe-echo check: the HELLO_OK carries back the
                     # residue class the server will actually serve. A

@@ -135,6 +135,12 @@ def _masked_lm_task(vocab_size: Optional[int], model_name: str, seq_len: int,
     def forward(variables, batch, train, rng):
         ids = batch["input_ids"].astype(jnp.int32)
         mask = batch["attention_mask"]
+        # Packed batches (the ragged token plane, ops/token_device.py):
+        # segment ids gate attention at sequence boundaries and position
+        # ids restart the positional embedding per packed sequence. Absent
+        # (the padded arm) the model runs its historical row-wise path.
+        seg = batch.get("segment_ids")
+        pos = batch.get("position_ids")
         if train and rng is not None:
             # On-device BERT masking: static shapes, no host RNG. The masked
             # positions double as the loss targets.
@@ -153,12 +159,14 @@ def _masked_lm_task(vocab_size: Optional[int], model_name: str, seq_len: int,
         if train and num_experts > 0:
             # MoE blocks sow their switch load-balance terms; collect them.
             logits, sown = model.apply(
-                variables, corrupted, mask, train=True, mutable=["aux_loss"]
+                variables, corrupted, mask, train=True, mutable=["aux_loss"],
+                segment_ids=seg, position_ids=pos,
             )
             for leaf in jax.tree_util.tree_leaves(sown.get("aux_loss", {})):
                 aux = aux + leaf
         else:
-            logits = model.apply(variables, corrupted, mask, train=train)
+            logits = model.apply(variables, corrupted, mask, train=train,
+                                 segment_ids=seg, position_ids=pos)
         return (logits, mlm_mask, aux), None
 
     def loss(outputs, batch):
@@ -208,15 +216,21 @@ def _causal_lm_task(vocab_size: Optional[int], model_name: str, seq_len: int,
     def forward(variables, batch, train, rng):
         ids = batch["input_ids"].astype(jnp.int32)
         mask = batch["attention_mask"]
+        # Packed batches: segments gate the (already causal) attention at
+        # sequence boundaries; positions restart per packed sequence.
+        seg = batch.get("segment_ids")
+        pos = batch.get("position_ids")
         aux = jnp.zeros((), jnp.float32)
         if train and num_experts > 0:
             logits, sown = model.apply(
-                variables, ids, mask, train=True, mutable=["aux_loss"]
+                variables, ids, mask, train=True, mutable=["aux_loss"],
+                segment_ids=seg, position_ids=pos,
             )
             for leaf in jax.tree_util.tree_leaves(sown.get("aux_loss", {})):
                 aux = aux + leaf
         else:
-            logits = model.apply(variables, ids, mask, train=train)
+            logits = model.apply(variables, ids, mask, train=train,
+                                 segment_ids=seg, position_ids=pos)
         return (logits, aux), None
 
     def _shifted(outputs, batch):
@@ -226,6 +240,12 @@ def _causal_lm_task(vocab_size: Optional[int], model_name: str, seq_len: int,
         # validity so padding after a final partial pack contributes nothing.
         targets = ids[:, 1:]
         w = batch["attention_mask"][:, 1:].astype(jnp.float32)
+        seg = batch.get("segment_ids")
+        if seg is not None:
+            # Packed rows: a position whose target belongs to a DIFFERENT
+            # packed sequence is a junction, not a prediction — weight it
+            # out, so the packed loss matches per-sequence semantics.
+            w = w * (seg[:, 1:] == seg[:, :-1]).astype(jnp.float32)
         return logits[:, :-1], targets, w, aux
 
     def loss(outputs, batch):

@@ -45,6 +45,18 @@ def dot_product_attention(q, k, v, mask=None, dtype=jnp.bfloat16,
     return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(dtype), v)
 
 
+def _accepts_segment_ids(fn) -> bool:
+    """Does this attention_fn take the packed-sequence ``segment_ids``
+    kwarg (``ops.flash.make_flash_attention`` does; ring attention and the
+    plain einsum path express segments as a dense mask instead)?"""
+    import inspect
+
+    try:
+        return "segment_ids" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 class SelfAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.bfloat16
@@ -53,7 +65,7 @@ class SelfAttention(nn.Module):
     # must bind their own causality (e.g. make_flash_attention(causal=True))
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, segment_ids=None):
         b, s, h = x.shape
         head_dim = h // self.num_heads
         dense = partial(
@@ -67,7 +79,12 @@ class SelfAttention(nn.Module):
         attn = self.attention_fn or partial(
             dot_product_attention, dtype=self.dtype, causal=self.causal
         )
-        out = attn(q, k, v, mask=mask)
+        if segment_ids is not None:
+            # Only reaches here when the fn declares the kwarg (the
+            # encoder lowers segments to a dense block mask otherwise).
+            out = attn(q, k, v, mask=mask, segment_ids=segment_ids)
+        else:
+            out = attn(q, k, v, mask=mask)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, h)
         return dense(features=h, axis=-1, name="out")(out)
 
@@ -82,12 +99,13 @@ class EncoderBlock(nn.Module):
     causal: bool = False
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, segment_ids=None):
         norm = partial(nn.LayerNorm, dtype=self.dtype, param_dtype=jnp.float32)
         y = norm(name="ln_attn")(x)
         y = SelfAttention(self.num_heads, self.dtype,
                           attention_fn=self.attention_fn,
-                          causal=self.causal, name="attn")(y, mask)
+                          causal=self.causal, name="attn")(y, mask,
+                                                           segment_ids)
         x = x + y
         y = norm(name="ln_mlp")(x)
         if self.num_experts > 0:
@@ -128,7 +146,8 @@ class TransformerEncoder(nn.Module):
     causal: bool = False  # decoder-only (GPT) variant: autoregressive mask
 
     @nn.compact
-    def __call__(self, input_ids, attention_mask=None, train: bool = True):
+    def __call__(self, input_ids, attention_mask=None, train: bool = True,
+                 segment_ids=None, position_ids=None):
         b, s = input_ids.shape
         embed = nn.Embed(self.vocab_size, self.hidden_size,
                          param_dtype=jnp.float32, name="tok_embed")
@@ -137,12 +156,35 @@ class TransformerEncoder(nn.Module):
             (self.max_len, self.hidden_size), jnp.float32,
         )
         x = embed(input_ids).astype(self.dtype)
-        x = x + pos_embed[:s].astype(self.dtype)
+        if position_ids is not None:
+            # Packed sequences (the ragged token plane): positions restart
+            # per segment, so the embedding gathers at the kernel-emitted
+            # intra-sequence offsets instead of the row arange.
+            x = x + jnp.take(
+                pos_embed, position_ids, axis=0
+            ).astype(self.dtype)
+        else:
+            x = x + pos_embed[:s].astype(self.dtype)
 
         mask = None
         if attention_mask is not None:
             # [B, S] -> [B, 1, 1, S]: keys masked out, broadcast over queries.
             mask = attention_mask[:, None, None, :].astype(bool)
+        seg_kwarg = None
+        if segment_ids is not None:
+            if self.attention_fn is not None and _accepts_segment_ids(
+                self.attention_fn
+            ):
+                # Segment-native attention (the Pallas flash kernel): pass
+                # the ids straight through; they carry validity too.
+                seg_kwarg = segment_ids
+                mask = None
+            else:
+                # Dense path: lower segments to the block mask [B,1,S,S] —
+                # same-segment-and-live; supersedes the validity mask.
+                from ..ops.flash import segment_attention_mask
+
+                mask = segment_attention_mask(segment_ids)
 
         block = EncoderBlock
         if self.remat:
@@ -158,7 +200,7 @@ class TransformerEncoder(nn.Module):
                       num_experts=self.num_experts if moe_here else 0,
                       capacity_factor=self.capacity_factor,
                       causal=self.causal,
-                      name=f"layer_{i}")(x, mask)
+                      name=f"layer_{i}")(x, mask, seg_kwarg)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_final")(x)
         if self.head == "none":

@@ -53,6 +53,23 @@ registry — README "Batch cache" for the full glossary):
 histogram, and the HBM replay tier's ``cache_device_batches`` gauge +
 ``cache_device_replay_epochs_total`` counter.
 
+Ragged-token series (r15, recorded by ``data/token_pack.py`` /
+``ops/token_device.py`` — README "Ragged token plane" for the full
+glossary): ``pack_payload_tokens_total`` vs ``pack_grid_tokens_total``
+(real vs processed tokens; their window ratio is ``pad_waste_pct`` /
+``pack_occupancy`` in the autotune signal dict — emitted by the padded
+control arm too, so the waste cut is measured, not assumed),
+``pack_sequences_total`` / ``pack_batches_total`` /
+``pack_truncated_tokens_total`` counters, ``pack_new_shapes_total``
+(fresh pack-kernel jit traces — the recompile cost the
+``pack_rows_quantum`` policy rung trades against waste), the sampled
+``pack_device_ms`` histogram, and the buffer plane's
+``bufpool_ragged_leases_total`` / ``bufpool_ragged_slack_bytes_total``
+(capacity-bucket overhead). ``decode_token_bytes_total`` /
+``decode_token_copies_total`` are the token path's LDT701 copy-hygiene
+rows: bytes leaving decode vs bytes that could not take the zero-copy
+Arrow view.
+
 Protocol series (r14 — README "Protocol"):
 
 * ``svc_proto_malformed_hello`` — counter: HELLOs rejected at the type

@@ -30,9 +30,25 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["make_flash_attention", "flash_available"]
+__all__ = ["make_flash_attention", "flash_available",
+           "segment_attention_mask"]
 
 _TPU_PLATFORMS = ("tpu", "axon")
+
+
+def segment_attention_mask(segment_ids: jax.Array) -> jax.Array:
+    """Packed-sequence attention mask: ``[B, S]`` segment ids (1-based;
+    0 = dead padding) → boolean ``[B, 1, S, S]`` where query q may attend
+    key k iff they belong to the same live segment. The dense-attention
+    form of what the Pallas kernel expresses natively via
+    ``SegmentIds(q, kv)`` — the ragged token plane's device-side pack
+    (:mod:`.token_device`) emits the ids, this builds the mask for the
+    XLA einsum path (and composes with the causal triangle inside
+    ``dot_product_attention``, which ANDs its own mask on top)."""
+    seg = segment_ids.astype(jnp.int32)
+    same = seg[:, None, :, None] == seg[:, None, None, :]
+    live = (seg > 0)[:, None, None, :]
+    return same & live
 
 
 def flash_available() -> bool:
@@ -57,10 +73,15 @@ def make_flash_attention(block_q: int = 512, block_k: int = 512,
     if use_pallas:
         from jax.experimental.pallas.ops.tpu import flash_attention as fa
 
-    def attention_fn(q, k, v, mask=None, dtype=None):
+    def attention_fn(q, k, v, mask=None, dtype=None, segment_ids=None):
         if not use_pallas:
             from ..models.transformer import dot_product_attention
 
+            if segment_ids is not None:
+                # Packed sequences: the block mask supersedes the plain
+                # key-validity mask (it encodes validity AND segment
+                # boundaries); causal still composes inside.
+                mask = segment_attention_mask(segment_ids)
             return dot_product_attention(q, k, v, mask=mask, dtype=q.dtype,
                                          causal=causal)
         scale = 1.0 / float(q.shape[-1]) ** 0.5
@@ -78,12 +99,19 @@ def make_flash_attention(block_q: int = 512, block_k: int = 512,
             block_k_dq=min(block_k, seq),
             block_q_dq=min(block_q, seq),
         )
-        segment_ids = None
-        if mask is not None:
+        seg = None
+        if segment_ids is not None:
+            # The kernel's native packed-sequence form: tokens attend only
+            # within equal ids, so the ragged plane's 1-based segments
+            # (0 = padding) map straight through — padding forms its own
+            # segment whose outputs are dead (the loss masks them).
+            ids = segment_ids.astype(jnp.int32)
+            seg = fa.SegmentIds(q=ids, kv=ids)
+        elif mask is not None:
             valid = mask.reshape(mask.shape[0], mask.shape[-1]).astype(jnp.int32)
-            segment_ids = fa.SegmentIds(q=valid, kv=valid)
+            seg = fa.SegmentIds(q=valid, kv=valid)
         out = fa.flash_attention(
-            q, k, v, segment_ids=segment_ids, sm_scale=scale,
+            q, k, v, segment_ids=seg, sm_scale=scale,
             block_sizes=sizes, causal=causal,
         )
         return out.astype(q.dtype)

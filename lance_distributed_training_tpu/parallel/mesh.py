@@ -155,15 +155,32 @@ def make_global_batch(
     from ._compat import device_put, make_array_from_process_local_data
     from .sharding import batch_partition_spec
 
-    def _put(x):
+    def _put(x, replicate: bool = False):
         x = np.asarray(x)
-        spec = batch_partition_spec(x.ndim, data_axis=data_axis,
-                                    seq_axis=seq_axis)
+        if replicate:
+            spec = P()
+        else:
+            spec = batch_partition_spec(x.ndim, data_axis=data_axis,
+                                        seq_axis=seq_axis)
         sharding = NamedSharding(mesh, spec)
         if jax.process_count() == 1:
             return device_put(x, sharding)
         return make_array_from_process_local_data(sharding, x)
 
+    if isinstance(pytree, dict):
+        # Ragged token leaves (data/token_pack.py convention) have no
+        # per-row leading dim to split — a flat values page replicates;
+        # _host_* metadata stays numpy (the pack transform reads its grid
+        # shape host-side, zero device syncs).
+        from ..data.token_pack import is_host_meta_key, is_ragged_key
+
+        return {
+            k: (
+                np.asarray(v) if is_host_meta_key(k)
+                else _put(v, replicate=is_ragged_key(k))
+            )
+            for k, v in pytree.items()
+        }
     return jax.tree_util.tree_map(_put, pytree)
 
 

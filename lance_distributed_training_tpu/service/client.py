@@ -76,7 +76,9 @@ class RemoteLoader:
         timeout_s: float = 120.0,
         task_type: Optional[str] = None,
         image_size: Optional[int] = None,
+        seq_len: Optional[int] = None,
         device_decode: Optional[bool] = None,
+        token_pack: Optional[bool] = None,
         dataset_fingerprint: Optional[str] = None,
         registry: Optional[MetricsRegistry] = None,
         buffer_pool=None,
@@ -101,7 +103,13 @@ class RemoteLoader:
         # time (silent wrong-resolution training is the alternative).
         self.task_type = task_type
         self.image_size = image_size
+        self.seq_len = seq_len
         self.device_decode = device_decode
+        # Ragged token plane (v4+): True asks the server for packed
+        # variable-length batches. NOT downgrade-safe — _dial_once refuses
+        # peers below TOKEN_PACK_MIN_VERSION instead of downgrade-retrying
+        # (a pre-v4 server would silently stream padded rows).
+        self.token_pack = token_pack
         # Declared dataset identity (Dataset.fingerprint() of a locally
         # readable copy, when the trainer has one): the server rejects a
         # mismatched copy at connect time. None = undeclared, skipped.
@@ -213,7 +221,9 @@ class RemoteLoader:
             version=self._hello_version,
             task_type=self.task_type,
             image_size=self.image_size,
+            seq_len=self.seq_len,
             device_decode=self.device_decode,
+            token_pack=self.token_pack,
             dataset_fingerprint=self.dataset_fingerprint,
         )
 
@@ -307,6 +317,18 @@ class RemoteLoader:
                     f"server speaks protocol {reply.get('version')}, "
                     f"client supports {P.MIN_PROTOCOL_VERSION}.."
                     f"{P.PROTOCOL_VERSION}"
+                )
+            if self.token_pack and int(
+                reply.get("version", 0)
+            ) < P.TOKEN_PACK_MIN_VERSION:
+                # Packing is not downgrade-safe: an older server ignores
+                # the token_pack field and streams padded rows while this
+                # client believes it negotiated the ragged plane — refuse,
+                # never downgrade-retry (the striping precedent).
+                raise P.ProtocolError(
+                    f"data server speaks protocol {reply.get('version')} < "
+                    f"{P.TOKEN_PACK_MIN_VERSION} (no token_pack support) — "
+                    "upgrade it or train with --no_token_pack"
                 )
             # Cursor-echo check (LDT1401 closes the loop on every HELLO_OK
             # field): the server slices its plan at the echoed start_step —

@@ -117,7 +117,8 @@ def _batch_frame(step: int, batch: Dict[str, np.ndarray],
     (``tensor_views`` + ``send_batch_frame`` — byte-identical to
     ``encode_batch``, which the verify pass pins)."""
     metas, views = P.tensor_views(batch)
-    meta = P.encode_batch_meta(step, metas, lineage)
+    meta = P.encode_batch_meta(step, metas, lineage,
+                               ragged=P.ragged_meta(batch))
     sink = _ByteSink()
     P.send_batch_frame(sink, meta, views)
     return sink.value()
@@ -188,6 +189,23 @@ def _golden_coeff_tensors() -> Dict[str, np.ndarray]:
             [[16, 16, 2, 2, 1, 1]], dtype=np.int32
         ),
         "label": np.array([5], dtype=np.int64),
+    }
+
+
+def _golden_ragged_tensors() -> Dict[str, np.ndarray]:
+    """Fixed ragged-token tensors in the real token-pack batch schema
+    (``data/token_pack.py``): a bucket-padded flat values page, offsets,
+    and the FFD pack plan — the v4 ``--token_pack`` wire shape. The meta's
+    ``ragged`` field is DERIVED from the key convention by the encoder
+    (``protocol.ragged_meta``), which is what the round-trip pins."""
+    values = np.zeros(32, dtype=np.int32)
+    values[:20] = np.arange(2, 22, dtype=np.int32)
+    return {
+        "input_ids__values": values,
+        "input_ids__offsets": np.array([0, 5, 12, 20], dtype=np.int32),
+        "_pack_slot": np.array([0, 0, 1], dtype=np.int32),
+        "_pack_start": np.array([8, 0, 0], dtype=np.int32),
+        "_host_pack_meta": np.array([2, 16, 20, 0], dtype=np.int32),
     }
 
 
@@ -298,20 +316,21 @@ GOLDEN_SPECS: List[GoldenSpec] = [
     # -- v3: striping, device decode, fingerprints, fleet -------------------
     GoldenSpec(
         "v3_hello_full", 3, "MSG_HELLO",
-        lambda: _frame(P.MSG_HELLO, _hello_current()),
-        note="the newest default HELLO (all fields, no features engaged)",
+        lambda: _frame(P.MSG_HELLO, _hello_current(version=3)),
+        note="the current constructor offering v3 (all fields, no "
+             "features engaged)",
     ),
     GoldenSpec(
         "v3_hello_striped", 3, "MSG_HELLO",
         lambda: _frame(P.MSG_HELLO, _hello_current(
-            start_step=8, stripe_index=1, stripe_count=4,
+            version=3, start_step=8, stripe_index=1, stripe_count=4,
         )),
         note="fleet stripe HELLO (residue class 1 of 4 from step 8)",
     ),
     GoldenSpec(
         "v3_hello_coeff", 3, "MSG_HELLO",
         lambda: _frame(P.MSG_HELLO, _hello_current(
-            task_type="classification", image_size=224,
+            version=3, task_type="classification", image_size=224,
             device_decode=True,
         )),
         note="device-decode HELLO (coefficient pages, skew-checked)",
@@ -319,7 +338,7 @@ GOLDEN_SPECS: List[GoldenSpec] = [
     GoldenSpec(
         "v3_hello_fingerprint", 3, "MSG_HELLO",
         lambda: _frame(P.MSG_HELLO, _hello_current(
-            dataset_fingerprint="0123abcd" * 8,
+            version=3, dataset_fingerprint="0123abcd" * 8,
         )),
         note="dataset content-identity HELLO (r13 skew check)",
     ),
@@ -339,6 +358,28 @@ GOLDEN_SPECS: List[GoldenSpec] = [
         ),
         note="half-decoded coefficient-page batch (device-decode wire "
              "shape)",
+        batch=True,
+    ),
+    # -- v4: the ragged token plane -----------------------------------------
+    GoldenSpec(
+        "v4_hello_full", 4, "MSG_HELLO",
+        lambda: _frame(P.MSG_HELLO, _hello_current()),
+        note="the newest default HELLO (all fields, no features engaged)",
+    ),
+    GoldenSpec(
+        "v4_hello_token_pack", 4, "MSG_HELLO",
+        lambda: _frame(P.MSG_HELLO, _hello_current(token_pack=True)),
+        note="ragged-plane HELLO: packing requested (honoured only at "
+             "TOKEN_PACK_MIN_VERSION+; skew-checked against the server's "
+             "serving mode)",
+    ),
+    GoldenSpec(
+        "v4_batch_ragged", 4, "MSG_BATCH",
+        lambda: _batch_frame(
+            4, _golden_ragged_tensors(), dict(_GOLDEN_LINEAGE)
+        ),
+        note="ragged token batch: values/offsets pages + pack plan + the "
+             "derived meta 'ragged' field (capacity buckets)",
         batch=True,
     ),
     GoldenSpec(

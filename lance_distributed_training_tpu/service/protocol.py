@@ -49,6 +49,19 @@ downgrade-retrying. Version 3 also carries the **fleet control plane**
 message types (register / heartbeat / deregister / resolve) spoken between
 data servers, the coordinator, and fleet clients — same framing, small JSON
 control payloads, one request/reply per connection.
+
+Version 4 adds the **ragged token plane** (``data/token_pack.py``): the
+HELLO's ``token_pack`` boolean requests variable-length token batches, and
+a ragged MSG_BATCH's meta carries the ``ragged`` field — ``{column_base:
+values_capacity_bucket}`` naming which tensors are flat (bucket-padded)
+token pages rather than row tensors, so the receiver can validate the
+values/offsets view pair against the declared capacity bucket. Packing is
+NOT downgrade-safe (a v3 server would ignore ``token_pack`` and stream
+padded rows while the client believes it negotiated packing), so a packing
+client must require the negotiated version >= ``TOKEN_PACK_MIN_VERSION``
+instead of downgrade-retrying; a v3 (or non-packing v4) peer negotiates
+packing OFF and receives the exact bit-identical padded stream the
+pre-r15 protocol carried.
 """
 
 from __future__ import annotations
@@ -68,6 +81,8 @@ __all__ = [
     "MIN_PROTOCOL_VERSION",
     "LINEAGE_MIN_VERSION",
     "STRIPE_MIN_VERSION",
+    "TOKEN_PACK_MIN_VERSION",
+    "ragged_meta",
     "version_supported",
     "is_json_int",
     "hello_malformed",
@@ -101,10 +116,10 @@ __all__ = [
     "ProtocolError",
 ]
 
-PROTOCOL_VERSION = 3
+PROTOCOL_VERSION = 4
 # Oldest peer version this build still speaks. v1 framing is a strict
-# subset of v2 (no lineage meta key), and an unstriped v3 HELLO is a strict
-# subset of v2's, so the floor stays at 1.
+# subset of v2 (no lineage meta key), an unstriped v3 HELLO is a strict
+# subset of v2's, and a pack-less v4 HELLO of v3's, so the floor stays 1.
 MIN_PROTOCOL_VERSION = 1
 # First version whose batch meta may carry the lineage field.
 LINEAGE_MIN_VERSION = 2
@@ -112,6 +127,12 @@ LINEAGE_MIN_VERSION = 2
 # striping client MUST refuse older peers (they'd ignore the unknown keys
 # and serve every step — silent duplication), never downgrade-retry.
 STRIPE_MIN_VERSION = 3
+# First version whose HELLO token_pack is honoured and whose batch meta may
+# carry the ragged field. A packing client MUST refuse older peers (they'd
+# ignore the request and stream padded rows the client believes are
+# packed), never downgrade-retry; non-packing peers of any version get the
+# bit-identical padded stream.
+TOKEN_PACK_MIN_VERSION = 4
 # Error-message prefix every version rejection starts with — the marker the
 # client's downgrade retry keys on. FROZEN wire prose: deployed v1 servers
 # already say exactly "protocol version mismatch: server 1, client N", and
@@ -153,6 +174,7 @@ _HELLO_FIELD_TYPES = (
     ("stripe_index", is_json_int, "integer"),
     ("stripe_count", is_json_int, "integer"),
     ("image_size", is_json_int, "integer"),
+    ("seq_len", is_json_int, "integer"),
     ("sampler_type", lambda v: isinstance(v, str), "string"),
     ("client_id", lambda v: isinstance(v, str), "string"),
     ("task_type", lambda v: isinstance(v, str), "string"),
@@ -160,6 +182,7 @@ _HELLO_FIELD_TYPES = (
     ("shuffle", lambda v: isinstance(v, bool), "boolean"),
     ("probe", lambda v: isinstance(v, bool), "boolean"),
     ("device_decode", lambda v: isinstance(v, bool), "boolean"),
+    ("token_pack", lambda v: isinstance(v, bool), "boolean"),
     (
         "columns",
         lambda v: isinstance(v, list)
@@ -340,6 +363,21 @@ def recv_msg(
     return msg_type, out
 
 
+def ragged_meta(batch: dict) -> Optional[dict]:
+    """The v4 batch-meta ``ragged`` field for a host batch, derived from
+    the ragged key convention (``data/token_pack.py``): ``{column_base:
+    values_capacity_bucket}`` for every ``<base>__values`` tensor, or
+    ``None`` for a plain row batch (the field is then omitted — v1..v3
+    frames stay byte-identical). Deriving it from the batch itself is what
+    makes decode → re-encode byte-identity hold for ragged goldens with no
+    extra plumbing."""
+    out = {}
+    for name, arr in batch.items():
+        if name.endswith("__values"):
+            out[name[: -len("__values")]] = int(np.asarray(arr).shape[0])
+    return out or None
+
+
 def encode_batch(step: int, batch: dict,
                  lineage: Optional[dict] = None) -> bytes:
     """One plan step's host batch → a MSG_BATCH payload.
@@ -347,10 +385,11 @@ def encode_batch(step: int, batch: dict,
     Arrays are serialised raw (C-contiguous dtype/shape + buffer), never
     pickled — the hot path moves bytes, not objects. ``lineage`` (v2+,
     :mod:`..obs.lineage`) rides the JSON meta as an extra key: a v1 decoder
-    reads ``step``/``tensors`` and never sees it.
+    reads ``step``/``tensors`` and never sees it. Ragged token batches
+    (v4+) additionally carry the derived :func:`ragged_meta` field.
     """
     metas, body = encode_tensors(batch)
-    meta = encode_batch_meta(step, metas, lineage)
+    meta = encode_batch_meta(step, metas, lineage, ragged=ragged_meta(batch))
     return b"".join([_META_LEN.pack(len(meta)), meta, body])
 
 
@@ -415,12 +454,18 @@ def _sendmsg_all(sock: socket.socket, views: list) -> None:
 
 
 def encode_batch_meta(step: int, tensor_metas: list,
-                      lineage: Optional[dict] = None) -> bytes:
+                      lineage: Optional[dict] = None,
+                      ragged: Optional[dict] = None) -> bytes:
     """The small JSON meta half of a MSG_BATCH payload (see
-    :func:`encode_batch` for the lineage/v1 contract)."""
+    :func:`encode_batch` for the lineage/v1 contract). ``ragged`` (v4+,
+    :func:`ragged_meta`) names the batch's flat token-page tensors and
+    their capacity buckets; omitted when absent, so pre-ragged frames stay
+    byte-identical."""
     header = {"step": int(step), "tensors": tensor_metas}
     if lineage is not None:
         header["lineage"] = lineage
+    if ragged:
+        header["ragged"] = ragged
     return json.dumps(header).encode("utf-8")
 
 
@@ -473,6 +518,9 @@ def decode_batch(payload, with_lineage: bool = False,
     except (ValueError, UnicodeDecodeError) as exc:
         raise ProtocolError(f"undecodable batch meta: {exc}")
     offset += meta_len
+    ragged = meta.get("ragged")
+    if ragged is not None and not isinstance(ragged, dict):
+        raise ProtocolError("batch meta 'ragged' field is not a dict")
     out = {}
     for name, dtype_str, shape in meta["tensors"]:
         dtype = np.dtype(dtype_str)
@@ -480,6 +528,20 @@ def decode_batch(payload, with_lineage: bool = False,
         nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
         if len(view) < offset + nbytes:
             raise ProtocolError(f"batch frame truncated inside tensor {name!r}")
+        if ragged and name.endswith("__values"):
+            # Ragged view pair (v4): the declared capacity bucket must
+            # match the flat page actually shipped — a disagreement means
+            # a torn frame or a sender whose pool bucketing drifted from
+            # the schema, and decoding it would hand the pack kernel a
+            # misaligned token run.
+            declared = ragged.get(name[: -len("__values")])
+            if declared is not None and (
+                not is_json_int(declared) or int(declared) != int(shape[0])
+            ):
+                raise ProtocolError(
+                    f"ragged tensor {name!r}: declared capacity bucket "
+                    f"{declared!r} != shipped page of {shape[0]}"
+                )
         src = np.frombuffer(
             view[offset : offset + nbytes], dtype=dtype
         ).reshape(shape)
@@ -594,7 +656,9 @@ def hello(
     probe: bool = False,
     task_type: Optional[str] = None,
     image_size: Optional[int] = None,
+    seq_len: Optional[int] = None,
     device_decode: Optional[bool] = None,
+    token_pack: Optional[bool] = None,
     dataset_fingerprint: Optional[str] = None,
     version: int = PROTOCOL_VERSION,
 ) -> dict:
@@ -637,12 +701,25 @@ def hello(
         "probe": bool(probe),
         "task_type": task_type,
         "image_size": int(image_size) if image_size is not None else None,
+        # Text-task decode shape (r15): the padded arm's static sequence
+        # length and the pack_len default. Declared, it must match the
+        # server's --seq_len — a mismatch would stream batches the model's
+        # max_len cannot take (a mid-epoch shape crash instead of this
+        # connect-time skew rejection). None = non-text task or old caller.
+        "seq_len": int(seq_len) if seq_len is not None else None,
         # None = undeclared (old callers): the server skips the check, as
         # with task_type/image_size. Declared, it must match the server's
         # pixel-vs-coefficient-page serving mode.
         "device_decode": (
             bool(device_decode) if device_decode is not None else None
         ),
+        # Ragged token plane (v4+): True asks for packed variable-length
+        # batches (values/offsets pages + pack plan); only honoured when
+        # the negotiated version >= TOKEN_PACK_MIN_VERSION — the CLIENT
+        # enforces that floor (packing is not downgrade-safe), the server
+        # skew-checks the request against its own serving mode. None =
+        # undeclared (old callers): padded stream, check skipped.
+        "token_pack": bool(token_pack) if token_pack is not None else None,
         # Content identity of the dataset the client opened locally
         # (Dataset.fingerprint(), r13): the server rejects a mismatch —
         # serving rows from a DIFFERENT copy of "the same" path would

@@ -82,6 +82,20 @@ class ServeConfig:
     # half as their jitted device kernel (ops/jpeg_device.py). Both sides
     # must agree — the HELLO's device_decode field is skew-checked like
     # task_type/image_size. Classification only.
+    token_pack: bool = False  # ragged token plane (data/token_pack.py,
+    # text tasks): serve variable-length token batches as values/offsets
+    # pages + a deterministic pack plan; clients finish them with the
+    # jitted pack kernel (ops/token_device.py). Per-SESSION negotiated:
+    # a v4 client whose HELLO asks token_pack gets the ragged stream;
+    # any other peer (v3, or a v4 padded client) gets the bit-identical
+    # padded stream this server always served — so one packing server
+    # keeps every old trainer working. A packing CLIENT against a
+    # non-packing server is rejected at connect (skew), like device_decode.
+    seq_len: int = 128  # padded sequence length for the text tasks (the
+    # padded arm's static shape, and the default pack_len cap); must match
+    # the trainer's --seq_len — decode config, like image_size
+    pack_len: int = 0  # packed slot-length cap; 0 = seq_len
+    pack_rows_multiple: int = 8  # packed row-count rounding quantum
     batch_cache: bool = False  # epoch-coherent decoded-batch cache
     # (data/cache.py): hits are served straight into the sender path — a
     # second epoch, a reconnected/restarted trainer, or a SECOND client
@@ -133,6 +147,9 @@ class _ClientSession:
         self.last_acked = -1
         self.client_id = ""
         self.peer_version = P.PROTOCOL_VERSION  # refined by the HELLO
+        # Session decode hook: the padded decoder until the handshake
+        # negotiates the ragged stream (v4 + token_pack HELLO).
+        self.decode_fn = service.decode_fn_padded
         # Clamp to >=1: maxsize=0 would mean UNBOUNDED, silently voiding the
         # backpressure guarantee (one stalled trainer buffering the whole
         # remaining epoch server-side).
@@ -193,6 +210,17 @@ class _ClientSession:
             if skew:
                 P.send_msg(self.sock, P.MSG_ERROR, {"message": skew})
                 return
+            # Ragged-stream negotiation (v4+): the token_pack request is
+            # honoured only at TOKEN_PACK_MIN_VERSION or newer — an older
+            # peer cannot have asked (the field is v4 vocabulary), and a
+            # v4 peer that did not ask keeps the padded stream. The skew
+            # check above already rejected a packing client against a
+            # non-packing server.
+            if (
+                self.peer_version >= P.TOKEN_PACK_MIN_VERSION
+                and bool(req.get("token_pack"))
+            ):
+                self.decode_fn = svc.decode_fn  # ldt: ignore[LDT1002] -- set during the handshake, before _stream spawns the producer that reads it; happens-before
             plan = svc.plan_for(req)
             start = int(req.get("start_step", 0))
             if not 0 <= start <= len(plan):
@@ -362,7 +390,12 @@ class _ClientSession:
                         lineage.pop("created_mono_ns", None)
                     else:  # v1 peer: omit the field (bit-identical v1)
                         lineage = None
-                    meta = P.encode_batch_meta(step, metas, lineage)
+                    # Ragged view declaration (v4): derived from the batch
+                    # itself — None (field omitted) for every padded
+                    # stream, so pre-ragged frames stay byte-identical.
+                    meta = P.encode_batch_meta(
+                        step, metas, lineage, ragged=P.ragged_meta(batch)
+                    )
                     sent = P.send_batch_frame(self.sock, meta, views)
                 svc.counters.add("batches_sent")
                 svc.counters.add("bytes_sent", sent)
@@ -417,10 +450,14 @@ class _ClientSession:
             # evicted before its fetch decodes inline, never off the
             # iterator — consuming a pool result for a skipped item would
             # shift every later step (silent reorder).
-            cache = svc.plan_cache_for(req)
+            cache = svc.plan_cache_for(req, self.decode_fn)
             miss_iter = None
             probed = None
-            if svc.workers is not None:
+            # The worker pool was built around the server's PRIMARY
+            # decoder; a padded-fallback session of a token_pack server
+            # decodes inline instead (old-peer traffic is the compat tail,
+            # not the hot path).
+            if svc.workers is not None and self.decode_fn is svc.decode_fn:
                 to_decode = items
                 if cache is not None:
                     probed = [cache.contains(item) for item in items]
@@ -448,7 +485,7 @@ class _ClientSession:
                         if cache is not None:
                             batch = cache.get(item, pool=svc.buffer_pool)
                         if batch is None:
-                            batch = svc.decode_fn(
+                            batch = self.decode_fn(
                                 svc.read_item(item, columns)
                             )
                             if cache is not None:
@@ -522,10 +559,39 @@ class DataService:
             self.buffer_pool = default_buffer_pool()
         # The SAME dispatch the trainer uses — the bit-identical-batches
         # guarantee depends on both sides binding one decoder implementation.
+        text_task = config.task_type in (
+            "masked_lm", "causal_lm", "contrastive"
+        )
+        tp_cfg = None
+        if config.token_pack:
+            if not text_task:
+                raise ValueError(
+                    "token_pack packs token columns and needs a text "
+                    f"task_type, got {config.task_type!r}"
+                )
+            from ..data.token_pack import TokenPackConfig
+
+            tp_cfg = TokenPackConfig(
+                pack_len=config.pack_len or config.seq_len,
+                rows_multiple=config.pack_rows_multiple,
+            )
         self.decode_fn = decoder_for_task(
             config.task_type, config.image_size, buffer_pool=self.buffer_pool,
             device_decode=config.device_decode,
+            token_pack=tp_cfg,
+            seq_len=config.seq_len if text_task else None,
         )
+        # Per-session padded fallback: v3 peers (and v4 clients that did
+        # not ask for packing) negotiate packing OFF and stream the exact
+        # padded bytes a non-packing server serves — one server, both arms.
+        self.decode_fn_padded = self.decode_fn
+        if tp_cfg is not None:
+            self.decode_fn_padded = decoder_for_task(
+                config.task_type, config.image_size,
+                buffer_pool=self.buffer_pool,
+                device_decode=config.device_decode,
+                seq_len=config.seq_len,
+            )
         self.counters = ServiceCounters()
         # Epoch-coherent batch cache (ServeConfig.batch_cache): one tiered
         # RAM/disk cache shared by every client session — the tf.data
@@ -669,6 +735,31 @@ class DataService:
                 f"device_decode={bool(cfg.device_decode)}, client expects "
                 f"{bool(dd)}"
             )
+        sl = req.get("seq_len")
+        if (
+            sl is not None
+            and cfg.task_type in ("masked_lm", "causal_lm", "contrastive")
+            and int(sl) != cfg.seq_len
+        ):
+            # The text twin of the image_size check: a seq_len-64 trainer
+            # fed (B, 128) padded batches crashes mid-epoch on the model's
+            # max_len (or silently trains a differently-packed layout) —
+            # reject at connect time like every other decode knob.
+            return (
+                f"decode-config skew: server pads/packs to seq_len="
+                f"{cfg.seq_len}, client expects {sl}"
+            )
+        if bool(req.get("token_pack")) and not cfg.token_pack:
+            # Asymmetric by design: a packing CLIENT needs the ragged
+            # stream this server is not configured to produce — reject.
+            # The converse (padded client, packing server) is fine: the
+            # session falls back to the padded decoder, bit-identical to
+            # a non-packing server's stream.
+            return (
+                "decode-config skew: client requests token_pack but this "
+                "server serves padded token batches (restart serve-data "
+                "with --token_pack)"
+            )
         fp = req.get("dataset_fingerprint")
         if fp is not None and str(fp) != self.dataset.fingerprint():
             # The client opened the dataset locally and declared its
@@ -684,12 +775,16 @@ class DataService:
             )
         return None
 
-    def plan_cache_for(self, req: dict):
+    def plan_cache_for(self, req: dict, decode_fn=None):
         """This handshake's :class:`~..data.cache.PlanCache` binding of the
         shared batch cache (``None`` when the cache is off). The scope
         carries the decode fingerprint + column projection; plan items are
         content-hashed, so two clients (or two epochs, or a reconnect)
-        asking for the same rows share entries."""
+        asking for the same rows share entries. ``decode_fn`` is the
+        SESSION's negotiated decoder (packed vs padded sessions of one
+        token_pack server must never share cache entries — their bytes
+        differ; the fingerprint keeps them disjoint and also re-scopes on
+        live pack-knob moves, the bucket-edge aliasing guard)."""
         if self.batch_cache is None:
             return None
         from ..data.cache import (
@@ -698,6 +793,7 @@ class DataService:
             plan_fingerprint,
         )
 
+        fn = decode_fn if decode_fn is not None else self.decode_fn
         columns = req.get("columns")
         cols = list(columns) if columns is not None else None
         return PlanCache(
@@ -706,7 +802,7 @@ class DataService:
             # Callable: re-evaluated per key, so live decoder knob moves
             # re-scope later entries instead of aliasing old-geometry ones.
             lambda: plan_fingerprint(
-                decode=decode_fingerprint(self.decode_fn), columns=cols,
+                decode=decode_fingerprint(fn), columns=cols,
             ),
         )
 

@@ -131,6 +131,23 @@ class TrainConfig:
     # degrades to the host pixel path (with one warning) when the native
     # coefficient extractor is unavailable. False (--no_device_decode) =
     # the exact r11 host decode path, the A/B control arm.
+    token_pack: bool = False  # ragged token plane (text tasks,
+    # data/token_pack.py + ops/token_device.py): variable-length sequences
+    # ride pool/wire/cache as values+offsets pages with a deterministic
+    # FFD pack plan, and one pure jitted kernel scatters them into packed
+    # (rows, pack_len) slabs with segment/position ids ahead of the step —
+    # the padding the fixed-shape path burns on every short sequence
+    # becomes a measured quantity (pad_waste_pct on /metrics) the
+    # autotuner can trade against recompile count. masked_lm/causal_lm
+    # pack multiple sequences per row (segment-masked attention,
+    # per-segment positions); contrastive buckets one caption per slot so
+    # row i stays paired with image i. Eval always streams the padded arm
+    # (per-sequence metrics need row alignment). False (--no_token_pack) =
+    # the exact r14 padded control arm.
+    pack_len: int = 0  # packed slot-length cap; 0 = seq_len. A bounded
+    # Tunable (with pack_rows_multiple) when the autotuner is on.
+    pack_rows_multiple: int = 8  # packed row-count rounding quantum:
+    # smaller = less padding waste, more distinct compiled shapes
     data_service_addr: Optional[str] = None  # host:port of a running
     # `ldt serve-data` DataService: decode runs on that host's fleet and this
     # process streams plan-ordered device-ready batches (RemoteLoader) —
@@ -602,15 +619,46 @@ def _loader_buffer_pool(config: TrainConfig):
     return default_buffer_pool()
 
 
-def _decoder_for(config: TrainConfig):
+_TEXT_TASKS = ("masked_lm", "causal_lm", "contrastive")
+
+
+def _token_pack_config(config: TrainConfig, mesh=None):
+    """The run's :class:`~.data.token_pack.TokenPackConfig`, or ``None``
+    when the ragged plane is off. ``mesh`` pins ``rows_align`` to the
+    data-axis size so every packed grid's row count divides over the
+    devices (the autotuner may move ``rows_multiple`` freely; the align
+    floor is immune)."""
+    if not config.token_pack:
+        return None
+    from .data.token_pack import TokenPackConfig
+
+    align = 1
+    if mesh is not None:
+        align = int(mesh.shape.get("data", 1))
+    return TokenPackConfig(
+        pack_len=config.pack_len or config.seq_len,
+        rows_multiple=config.pack_rows_multiple,
+        rows_align=align,
+    )
+
+
+def _decoder_for(config: TrainConfig, *, for_eval: bool = False, mesh=None):
     from .data.decode import decoder_for_task
 
-    return decoder_for_task(config.task_type, config.image_size,
-                            buffer_pool=_loader_buffer_pool(config),
-                            device_decode=config.device_decode)
+    text = config.task_type in _TEXT_TASKS
+    return decoder_for_task(
+        config.task_type, config.image_size,
+        buffer_pool=_loader_buffer_pool(config),
+        device_decode=config.device_decode,
+        # Eval always streams the padded arm: per-sequence metrics (and
+        # the full-coverage loader's _weight pads) need row alignment the
+        # FFD pack gives up.
+        token_pack=None if for_eval else _token_pack_config(config, mesh),
+        seq_len=config.seq_len if text else None,
+    )
 
 
-def _make_worker_pool(config: TrainConfig, dataset):
+def _make_worker_pool(config: TrainConfig, dataset, mesh=None):
     """Persistent decode-worker pool (``num_workers``/``persistent_workers``
     parity, ``/root/reference/lance_map_style.py:60-69``). None when
     ``num_workers == 0`` — decode then runs on the producer thread + the
@@ -619,7 +667,7 @@ def _make_worker_pool(config: TrainConfig, dataset):
         return None
     from .data.workers import WorkerPool, columnar_spec, folder_spec
 
-    decode = _decoder_for(config)
+    decode = _decoder_for(config, mesh=mesh)
     columns = getattr(decode, "required_columns", None)
     transport = "shm" if config.shm_workers else "pickle"
     pool = _loader_buffer_pool(config)
@@ -663,7 +711,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             f"global batch {config.batch_size} not divisible by "
             f"{process_count} processes"
         )
-    decode = _decoder_for(config)
+    decode = _decoder_for(config, mesh=mesh)
     # Placement: default is the async plane (host batches out of the
     # pipelines, one placement thread owning H2D); the control arm keeps
     # the legacy synchronous closure on the consumer thread.
@@ -695,7 +743,14 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             columns=getattr(decode, "required_columns", None),
             task_type=config.task_type,
             image_size=config.image_size,
+            # Text-task decode shape, skew-checked like image_size (a
+            # seq_len-64 trainer against a seq_len-128 server would crash
+            # mid-epoch on the model's max_len).
+            seq_len=(
+                config.seq_len if config.task_type in _TEXT_TASKS else None
+            ),
             device_decode=config.device_decode,
+            token_pack=config.token_pack,
             # Dataset-identity skew check (r13): when this host can read
             # the dataset too, declare its fingerprint so a server backed
             # by a DIFFERENT copy is rejected at connect time.
@@ -884,7 +939,7 @@ def _build_eval_loader(config: TrainConfig, dataset, mesh, index_pool=None,
     from .data.pipeline import make_eval_pipeline
 
     process_index, process_count = process_topology()
-    decode = _decoder_for(config)
+    decode = _decoder_for(config, for_eval=True)
     plane = _make_placement(config, mesh)
     if plane is not None:
         put = None
@@ -1039,6 +1094,35 @@ def train(config: TrainConfig) -> dict:
             f"supports task_type='classification' only, got "
             f"{config.task_type!r}"
         )
+    if config.token_pack:
+        if config.task_type not in _TEXT_TASKS:
+            raise ValueError(
+                "token_pack packs token columns and needs a text task "
+                f"({'/'.join(_TEXT_TASKS)}), got {config.task_type!r}"
+            )
+        if config.seq_parallelism > 1 or config.pipeline_parallelism > 1:
+            raise ValueError(
+                "token_pack is incompatible with seq_parallelism/"
+                "pipeline_parallelism: packed batches re-enter the data "
+                "layout inside the pack transform and carry no static "
+                "sequence split"
+            )
+        if (config.num_processes or 1) > 1:
+            raise ValueError(
+                "token_pack currently supports single-process training "
+                "only: each process's packed row count is data-dependent, "
+                "and multi-host global-batch assembly needs identical "
+                "per-process shapes"
+            )
+        if config.data_service_addr or config.coordinator_addr:
+            if (jax.local_device_count() if config.no_ddp is False else 1) > 1:
+                raise ValueError(
+                    "token_pack over a data service cannot yet align "
+                    "packed row counts to a multi-device mesh (the "
+                    "server's planner does not know this trainer's device "
+                    "count) — run single-device (--no_ddp) or decode "
+                    "locally until pack alignment rides the HELLO"
+                )
     if (
         config.device_decode
         and (config.num_processes or 1) > 1
@@ -1349,7 +1433,7 @@ def train(config: TrainConfig) -> dict:
             ).start()
             logger.log({"metrics_port": exporter.port}, to_wandb=False)
         if not (config.data_service_addr or config.coordinator_addr):
-            worker_pool = _make_worker_pool(config, dataset)
+            worker_pool = _make_worker_pool(config, dataset, mesh)
             if config.batch_cache:
                 # Epoch-coherent batch cache (--batch_cache): ONE tiered
                 # RAM/disk cache for the whole run — the epoch loop
@@ -1472,6 +1556,25 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
     transform = None
     transform_hist = None
     device_ms_hist = None
+    probe_key = "image"  # leaf the sampled transform-await fetches from
+    if config.token_pack:
+        # Ragged token plane: the pack kernel (ops/token_device.py)
+        # scatters values/offsets pages into packed (rows, L) slabs with
+        # segment/position ids — the text-path twin of the device-decode
+        # stage below (mutually exclusive by task type). Padded batches
+        # (the control arm, and every eval loader) pass through whole.
+        from .obs.registry import default_registry
+        from .ops.token_device import make_pack_transform
+
+        # Packed grids come out of the replicated-input kernel replicated;
+        # re-lay them onto the data axis so the step's in_shardings accept
+        # them (the planner's rows_align makes the row count divide).
+        transform = make_pack_transform(
+            batch_sharding=batch_sharding(mesh) if mesh is not None else None
+        )
+        transform_hist = default_registry().histogram("trainer_transform_ms")
+        device_ms_hist = default_registry().histogram("pack_device_ms")
+        probe_key = "input_ids"
     if config.device_decode:
         from .obs.registry import default_registry
         from .ops.jpeg_device import make_batch_transform
@@ -1587,16 +1690,17 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
                 with obs_span("train.transform", step=global_step):
                     batch = transform(raw)
                     decoded = batch is not raw
-                    if sample and decoded:
-                        # Await the sampled kernel run so decode_device_ms
-                        # records execution, not dispatch — via a scalar
-                        # VALUE fetch, not block_until_ready (the tunneled
-                        # TPU backend returns from block_until_ready before
-                        # execution completes; fetching any element forces
-                        # the producing kernel to finish). Degraded pixel
-                        # batches pass through `raw` unchanged and are
-                        # never sampled.
-                        _ = int(batch["image"][0, 0, 0, 0])
+                    if sample and decoded and probe_key in batch:
+                        # Await the sampled kernel run so the device-cost
+                        # histogram records execution, not dispatch — via a
+                        # scalar VALUE fetch, not block_until_ready (the
+                        # tunneled TPU backend returns from
+                        # block_until_ready before execution completes;
+                        # fetching any element forces the producing kernel
+                        # to finish). Degraded/padded batches pass through
+                        # `raw` unchanged and are never sampled.
+                        leaf = batch[probe_key]
+                        _ = int(leaf[(0,) * leaf.ndim])
                 dt_ms = (time.monotonic_ns() - t0) / 1e6
                 transform_hist.observe(dt_ms)
                 if sample and decoded:

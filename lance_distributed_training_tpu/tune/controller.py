@@ -103,6 +103,24 @@ def derive_window(delta: Dict[str, float]) -> Dict[str, float]:
         total = entropy_p50 + device_p50
         if total > 0:
             w["decode_split"] = entropy_p50 / total
+    # Ragged token plane (the --token_pack arm AND its padded control):
+    # padding waste as a live signal. payload = real tokens, grid = the
+    # token grid the device actually processes; their window ratio is what
+    # the pack policy rung trades against recompile count. Same process-
+    # locality caveat as decode_split: the counters live in the DECODING
+    # process.
+    payload = delta.get("pack_payload_tokens_total", 0.0)
+    grid_tokens = delta.get("pack_grid_tokens_total", 0.0)
+    if grid_tokens > 0:
+        w["pad_waste_pct"] = 100.0 * (grid_tokens - payload) / grid_tokens
+        w["pack_occupancy"] = payload / grid_tokens
+    new_shapes = delta.get("pack_new_shapes_total")
+    if new_shapes is not None:
+        # Fresh jit traces the pack transform paid this window (each is a
+        # compile): the cost side of a finer rows quantum. Lives in the
+        # TRAINER process (the transform runs there), so it is present
+        # even when decode is remote.
+        w["pack_new_shapes"] = new_shapes
     queue_wait = delta.get("svc_queue_wait_ms_p95")
     if queue_wait is not None:
         w["queue_wait_ms_p95"] = queue_wait

@@ -56,6 +56,12 @@ BOTTLENECK_CODES = {
     # host entropy half) dominates per-batch decode — more decode workers
     # cannot help, the ladder skips that rung.
     "device_transform_bound": 6,
+    # --token_pack runs: the packed grid carries too much dead padding —
+    # tighten the row-count quantum (finer rounding, more shapes).
+    "pad_waste_bound": 7,
+    # --token_pack runs: the pack transform is paying fresh jit traces
+    # every window — coarsen the quantum (fewer shapes, more padding).
+    "recompile_bound": 8,
 }
 
 # Capacity ladder for decode/transport-bound growth, in expected-payoff
@@ -102,6 +108,14 @@ class PolicyConfig:
     # device kernel, not host decode — the capacity ladder skips the
     # workers rung (spawning decode processes cannot move a device-bound
     # stall; the prefetch/stripe rungs still apply)
+    pad_waste_hi: float = 30.0  # --token_pack: dead-token share of the
+    # packed grid above which the pack rung tightens pack_rows_quantum
+    # (finer row rounding = less waste, more compiled shapes). Evaluated
+    # only OUTSIDE the stalled band — padding waste is a FLOP tax, not a
+    # stall, and the capacity rungs keep priority when the loader starves.
+    recompile_hi: float = 3.0  # --token_pack: fresh pack-transform jit
+    # traces per window above which the rung coarsens pack_rows_quantum
+    # (the opposite trade). Steady state sees 0 new shapes per window.
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -256,6 +270,38 @@ class HillClimbPolicy:
                 "device_transform_bound" if device_bound else "decode_bound"
             )
             return []
+        # Pack rung (--token_pack, outside the stalled band): trade the
+        # packed row-count quantum between padding waste (a FLOP tax the
+        # stall signal never sees) and recompile churn. Same hysteresis/
+        # cooldown/revert machinery as every other knob — _act arms the
+        # pending-revert judgment and the cooldown sit-out.
+        if "pack_rows_quantum" in knobs:
+            shapes = window.get("pack_new_shapes", 0.0)
+            if shapes >= c.recompile_hi and self._growable(
+                "pack_rows_quantum", knobs, bounds
+            ):
+                self._calm = 0
+                self.last_bottleneck = "recompile_bound"
+                return self._act(
+                    "pack_rows_quantum",
+                    _grow(knobs["pack_rows_quantum"],
+                          bounds["pack_rows_quantum"][1]),
+                    "recompile_bound", stall, knobs,
+                )
+            waste = window.get("pad_waste_pct")
+            if (
+                waste is not None
+                and waste >= c.pad_waste_hi
+                and self._shrinkable("pack_rows_quantum", knobs, bounds)
+            ):
+                self._calm = 0
+                self.last_bottleneck = "pad_waste_bound"
+                return self._act(
+                    "pack_rows_quantum",
+                    max(bounds["pack_rows_quantum"][0],
+                        knobs["pack_rows_quantum"] // 2),
+                    "pad_waste_bound", stall, knobs,
+                )
         if stall <= c.stall_lo_pct:
             self._calm += 1
             if self._calm >= c.shrink_patience:

@@ -184,6 +184,14 @@ echo "== batch-cache smoke (epoch-2 hits, digest parity, leak-clean) =="
 # leases under LDT_LEAK_SANITIZER=1 and zero stray spill temp files.
 timeout -k 10 540 env JAX_PLATFORMS=cpu LDT_LEAK_SANITIZER=1 PYTHONPATH=. python scripts/cache_smoke.py
 
+echo "== token-pack smoke (padded-vs-packed waste cut, digest parity) =="
+# The ragged token plane's two-arm gate: a --token_pack masked-LM run over
+# a long-tail variable-length corpus must put pack_* waste series on a
+# live /metrics scrape, cut measured padding waste >= 30 points vs the
+# padded control arm, reproduce bit-identical per-step digests across
+# packed repeats, and strand zero ragged page leases under the sanitizer.
+timeout -k 10 540 env JAX_PLATFORMS=cpu LDT_LEAK_SANITIZER=1 PYTHONPATH=. python scripts/token_pack_smoke.py
+
 echo "== protocol goldens (cross-version byte-identity gate) =="
 # Every checked-in frame blob decodes with the current build and
 # re-encodes byte-identically per version; the current encoders must

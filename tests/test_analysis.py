@@ -3309,3 +3309,76 @@ def test_deleting_a_skew_check_fails_ldt1401_at_the_field():
     # Reported at the field's write site in the schema owner — the
     # protocol module's hello() constructor.
     assert site.module.endswith("service/protocol.py")
+
+
+# -- LDT1501 padding hygiene --------------------------------------------------
+
+
+def test_ldt1501_flags_np_pad_on_hot_path(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import numpy as np
+
+        def collate(values, width):
+            return np.pad(values, (0, width - len(values)))
+    """}, hot_paths=["*"])
+    hits = [f for f in findings if f.rule == "LDT1501"]
+    assert len(hits) == 1
+    assert "token_pack" in hits[0].message
+
+
+def test_ldt1501_flags_full_max_len_allocation(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import numpy as np
+
+        def collate(rows, seq_len, pad_id):
+            page = np.full((len(rows), seq_len), pad_id)
+            grid = np.zeros((4, 8))  # content-sized: fine
+            return page, grid
+    """}, hot_paths=["*"])
+    hits = [f for f in findings if f.rule == "LDT1501"]
+    assert len(hits) == 1
+    assert "max-length token grid" in hits[0].message
+
+
+def test_ldt1501_flags_attribute_shaped_max_allocation(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import numpy as np
+
+        class Decoder:
+            def collate(self, rows):
+                return np.empty((len(rows), self.max_len), np.int32)
+    """}, hot_paths=["*"])
+    assert [f.rule for f in findings if f.rule == "LDT1501"] == ["LDT1501"]
+
+
+def test_ldt1501_exempts_token_pack_module(tmp_path):
+    findings = run_rules(tmp_path, {"token_pack.py": """\
+        import numpy as np
+
+        def pad(values, seq_len, pad_id):
+            page = np.full((len(values), seq_len), pad_id)
+            return np.pad(page, 1)
+    """}, hot_paths=["*"])
+    assert [f for f in findings if f.rule == "LDT1501"] == []
+
+
+def test_ldt1501_silent_off_hot_paths(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import numpy as np
+
+        def debug_tool(values, max_len):
+            return np.zeros((len(values), max_len))
+    """}, hot_paths=["somewhere/else.py"])
+    assert [f for f in findings if f.rule == "LDT1501"] == []
+
+
+def test_ldt1501_content_sized_allocations_pass(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        import numpy as np
+
+        def collate(lengths, values):
+            width = int(lengths.max())
+            page = np.zeros((len(lengths), width), values.dtype)
+            return page
+    """}, hot_paths=["*"])
+    assert [f for f in findings if f.rule == "LDT1501"] == []

@@ -36,7 +36,7 @@ def test_checked_in_corpus_round_trips():
 
 def test_corpus_covers_every_version_and_wire_message():
     versions = {s.version for s in G.GOLDEN_SPECS}
-    assert versions == {1, 2, 3}
+    assert versions == {1, 2, 3, 4}
     covered = {s.msg for s in G.GOLDEN_SPECS}
     wire_msgs = {n for n in dir(P) if n.startswith("MSG_")}
     assert covered == wire_msgs, (
@@ -63,6 +63,24 @@ def test_coeff_batch_golden_carries_device_decode_schema():
     assert {"jpeg_coef_y", "jpeg_coef_cb", "jpeg_coef_cr",
             "jpeg_quant", "jpeg_geom"} <= set(batch)
     assert batch["jpeg_coef_y"].dtype == np.int16
+
+
+def test_ragged_batch_golden_carries_token_pack_schema():
+    import json
+
+    data = (GOLDEN_DIR / "v4_batch_ragged.bin").read_bytes()
+    _type, payload = G._split_frame(data)
+    _step, batch, lineage = P.decode_batch(payload, with_lineage=True)
+    assert lineage == G._GOLDEN_LINEAGE
+    assert {"input_ids__values", "input_ids__offsets", "_pack_slot",
+            "_pack_start", "_host_pack_meta"} <= set(batch)
+    assert batch["input_ids__values"].dtype == np.int32
+    # The meta's ragged field declares the capacity bucket per column.
+    (meta_len,) = P._META_LEN.unpack_from(memoryview(payload), 0)
+    meta = json.loads(bytes(payload[4:4 + meta_len]))
+    assert meta["ragged"] == {
+        "input_ids": int(batch["input_ids__values"].shape[0])
+    }
 
 
 def test_version_mismatch_marker_is_pinned_by_a_golden():
