@@ -322,6 +322,11 @@ def build_graph_parser() -> argparse.ArgumentParser:
                         "hexagons with writer->msg->reader edges, "
                         "per-message field schemas, and the version-gate "
                         "annotations LDT1402 enforces")
+    p.add_argument("--loader", action="store_true",
+                   help="also render the unified loader graph "
+                        "(data/graph.py): the five canonical LoaderGraph "
+                        "shapes as node chains, with cursor owners and "
+                        "tunable-bearing nodes marked")
     return p
 
 
@@ -361,6 +366,15 @@ def graph_main(argv: Optional[Sequence[str]] = None, out=None) -> int:
         from .protomodel import build_proto_model
 
         proto = build_proto_model(program, config)
+    loaders = None
+    if args.loader:
+        # Spec-only canonical graphs: describe() never compiles, so this
+        # touches no dataset, socket, or decoder.
+        from ..data.graph import canonical_graphs
+
+        loaders = {
+            name: g.describe() for name, g in canonical_graphs().items()
+        }
 
     # thread root -> set of lock keys any function on that root acquires
     root_locks: dict = {}
@@ -486,6 +500,38 @@ def graph_main(argv: Optional[Sequence[str]] = None, out=None) -> int:
                         f'  "msg:{name}" -> "fn:{r}" '
                         '[color="#2563eb"];\n'
                     )
+        if loaders is not None:
+            # One cluster per canonical shape: the node chain left to
+            # right, cursor owner double-bordered, tunable bearers dashed.
+            for shape, desc in loaders.items():
+                cid = shape.replace("-", "_")
+                out.write(f'  subgraph "cluster_loader_{cid}" {{\n')
+                out.write(f'    label="loader: {shape}";\n')
+                prev = None
+                for i, node in enumerate(desc["nodes"]):
+                    nid = f"ldr:{shape}:{i}"
+                    label = node["node"]
+                    if node["detail"]:
+                        label += "\\n" + node["detail"]
+                    if node["tunables"]:
+                        label += "\\ntunables: " + ", ".join(
+                            node["tunables"]
+                        )
+                    style = "filled"
+                    if node["cursor"]:
+                        label += "\\n[cursor owner]"
+                    if node["tunables"]:
+                        style += ",dashed"
+                    peripheries = 2 if node["cursor"] else 1
+                    out.write(
+                        f'    "{nid}" [label="{label}", shape=box, '
+                        f'style="{style}", fillcolor="#f1f5f9", '
+                        f'peripheries={peripheries}];\n'
+                    )
+                    if prev is not None:
+                        out.write(f'    "{prev}" -> "{nid}";\n')
+                    prev = nid
+                out.write("  }\n")
         out.write("}\n")
     else:
         out.write(f"concurrency model over {files_checked} files: "
@@ -554,6 +600,31 @@ def graph_main(argv: Optional[Sequence[str]] = None, out=None) -> int:
                         mark += f" >={gate}"
                     parts.append(f + (f" [{mark.strip()}]" if mark else ""))
                 out.write(f"  msg {name}: {', '.join(parts)}\n")
+        if loaders is not None:
+            out.write(
+                f"  loader graph model (data/graph.py): {len(loaders)} "
+                "canonical shapes; * = cursor owner, ~ = tunable-bearing\n"
+            )
+            for shape, desc in loaders.items():
+                chain = " -> ".join(
+                    n["node"]
+                    + ("*" if n["cursor"] else "")
+                    + ("~" if n["tunables"] else "")
+                    for n in desc["nodes"]
+                )
+                out.write(f"  loader {shape}: {chain}\n")
+                for n in desc["nodes"]:
+                    marks = []
+                    if n["cursor"]:
+                        marks.append("cursor owner")
+                    if n["tunables"]:
+                        marks.append("tunables: " + ", ".join(n["tunables"]))
+                    tail = f" [{'; '.join(marks)}]" if marks else ""
+                    out.write(
+                        f"    {n['kind']:<10} {n['node']}"
+                        f"{' — ' + n['detail'] if n['detail'] else ''}"
+                        f"{tail}\n"
+                    )
     return 0
 
 

@@ -13,6 +13,7 @@ from . import (  # noqa: F401  (import for registration side effect)
     copies,
     determinism,
     dispatch,
+    graph,
     jit_purity,
     lockorder,
     obs,

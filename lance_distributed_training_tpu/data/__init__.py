@@ -43,5 +43,22 @@ from .cache import (  # noqa: F401
 )
 from .filters import parse_predicate, predicate_mask  # noqa: F401
 from .folder import FolderDataPipeline  # noqa: F401
+from .graph import (  # noqa: F401
+    Buffers,
+    Cache,
+    Decode,
+    DevicePut,
+    EvalSource,
+    FleetTransport,
+    FolderSource,
+    InProcess,
+    LanceSource,
+    LoaderGraph,
+    MapStyleSource,
+    Place,
+    Pool,
+    Prefetch,
+    ServiceTransport,
+)
 from .placement import PlacedLoader, PlacementPlane  # noqa: F401
 from .workers import WorkerPool, columnar_spec, folder_spec  # noqa: F401

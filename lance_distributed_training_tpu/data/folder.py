@@ -57,6 +57,10 @@ class FolderDataPipeline:
     Either way the decode hook receives ``{image: list[bytes], label:
     np.ndarray}`` shaped like a columnar read, so the SAME decoder classes
     work on both arms.
+
+    Since r16 this class is the runtime engine beneath a
+    :class:`~.graph.LoaderGraph` assembly (``FolderSource → Decode → ... →
+    InProcess``) — prefer composing the graph.
     """
 
     def __init__(

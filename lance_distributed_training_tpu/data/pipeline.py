@@ -66,11 +66,8 @@ from ..obs.spans import span
 from ..tune.tunable import AdjustableQueue, Tunable, _LiveQueues
 from .format import Dataset
 from .samplers import (
-    Plan,
     ReadRange,
-    assert_equal_step_counts,
     distributed_index_batches,
-    make_plan,
     slice_plan,
 )
 
@@ -112,6 +109,10 @@ def _with_columns(read_fn: Callable, columns) -> Callable:
 
 class DataPipeline:
     """Iterate device-ready batches for THIS process's shard of the data.
+
+    Since r16 this class is the runtime engine beneath a
+    :class:`~.graph.LoaderGraph` assembly (``LanceSource → Decode →
+    Cache → ... → InProcess``) — prefer composing the graph.
 
     Parameters
     ----------
@@ -524,7 +525,7 @@ def make_train_pipeline(
     columns: Optional[Sequence[str]] = None,
     buffer_pool=None,
     batch_cache=None,
-) -> DataPipeline:
+) -> "LoaderGraph":
     """Iterable-style pipeline — parity with ``get_sampler``+``get_dataset``+
     ``get_loader`` (``/root/reference/lance_iterable.py:53-72,86-88``).
 
@@ -533,53 +534,39 @@ def make_train_pipeline(
     cross-process plan set is validated for the equal-step-count invariant
     before any training starts — the static guard against the reference's
     documented fragment-imbalance deadlock (``README.md:140-157``).
-    """
-    rows = dataset.fragment_rows()
-    if sampler_type in ("full", "full_scan") and process_count > 1:
-        # The reference documents FullScanSampler as "not DP-aware" —
-        # single-device eval/debug only (/root/reference/README.md:126,
-        # 130-138). Multi-process, each process's identical full scan would
-        # be stitched into a bogus "global" batch of duplicated rows; refuse
-        # instead of silently training on duplicates.
-        raise ValueError(
-            "sampler_type='full' is not DP-aware (every process scans the "
-            f"whole dataset) and cannot run across {process_count} processes; "
-            "use sampler_type='batch' or 'fragment', or launch a single "
-            "process (no coordinator/multi-host env) for eval/debug"
-        )
-    if check_deadlock and sampler_type not in ("full", "full_scan"):
-        plans = [
-            make_plan(sampler_type, rows, batch_size, p, process_count,
-                      shuffle=shuffle, seed=seed, epoch=epoch)
-            for p in range(process_count)
-        ]
-        assert_equal_step_counts(plans, batch_size)
-        plan: Plan = plans[process_index]
-    else:
-        plan = make_plan(sampler_type, rows, batch_size, process_index,
-                         process_count, shuffle=shuffle, seed=seed, epoch=epoch)
-    plan_cache = None
-    if batch_cache is not None:
-        # Item-content keys make the binding epoch-coherent by
-        # construction: epoch e's plan items that replay epoch 0's rows
-        # hash to the SAME keys (whatever their step position), so every
-        # later epoch — shuffled batch order included — streams hits.
-        from .cache import PlanCache, decode_fingerprint, plan_fingerprint
 
-        cols = list(columns) if columns is not None else None
-        plan_cache = PlanCache(
-            batch_cache,
-            dataset.fingerprint(),
-            # Callable: evaluated per key, so a live decoder actuation
-            # (coeff_chunk) re-scopes later entries instead of aliasing.
-            lambda: plan_fingerprint(
-                decode=decode_fingerprint(decode_fn), columns=cols,
-            ),
-        )
-    return DataPipeline(dataset, plan, decode_fn, device_put_fn, prefetch,
-                        read_fn=_with_columns(_range_read, columns),
-                        workers=workers, producers=producers,
-                        buffer_pool=buffer_pool, plan_cache=plan_cache)
+    Since r16 a thin :class:`~.graph.LoaderGraph` assembly: plan
+    construction lives in :class:`~.graph.LanceSource`, the cache binding
+    in the graph's decode-boundary compile — compiled eagerly here so
+    construction-time errors (empty plan, non-DP-aware sampler) surface
+    exactly where they always did.
+    """
+    from .graph import (
+        Buffers,
+        Cache,
+        Decode,
+        DevicePut,
+        InProcess,
+        LanceSource,
+        LoaderGraph,
+        Pool,
+        Prefetch,
+    )
+
+    graph = LoaderGraph(
+        LanceSource(dataset, sampler_type, batch_size, process_index,
+                    process_count, shuffle=shuffle, seed=seed, epoch=epoch,
+                    check_deadlock=check_deadlock),
+        Decode(decode_fn, columns=columns),
+        Cache(batch_cache),
+        Pool(workers),
+        Buffers(buffer_pool),
+        Prefetch(prefetch, producers=producers),
+        DevicePut(device_put_fn),
+        InProcess(),
+    )
+    graph.compile()
+    return graph
 
 
 def make_eval_pipeline(
@@ -597,7 +584,7 @@ def make_eval_pipeline(
     buffer_pool=None,
     batch_cache=None,
     dataset_fingerprint: Optional[str] = None,
-) -> DataPipeline:
+) -> "LoaderGraph":
     """Full-coverage eval loader: every row exactly once, ONE compiled shape.
 
     Train loaders either drop the ragged tail (batch plans) or keep it ragged
@@ -613,45 +600,37 @@ def make_eval_pipeline(
     the columnar arm, the file-reading path for the folder arm — so both
     storage arms share this loader. Decode runs on producer threads (eval is
     a single pass; no worker-pool protocol needed).
-    """
-    from .samplers import padded_eval_index_batches
 
-    total = num_rows if index_pool is None else len(index_pool)
-    plan = padded_eval_index_batches(
-        total, global_batch, process_index, process_count,
-        index_pool=index_pool,
+    Since r16 a thin :class:`~.graph.LoaderGraph` assembly over
+    :class:`~.graph.EvalSource`; the caller-supplied ``dataset_fingerprint``
+    (computed ONCE at Dataset construction / FolderDataPipeline init, never
+    per eval rebuild) rides the Cache node, and the ``eval=1`` scope keeps
+    eval entries (they carry ``_weight``) disjoint from train entries over
+    the same rows.
+    """
+    from .graph import (
+        Buffers,
+        Cache,
+        Decode,
+        DevicePut,
+        EvalSource,
+        InProcess,
+        LoaderGraph,
+        Prefetch,
     )
 
-    def _read(_ds, entry):
-        idx, weights = entry
-        return read_fn(idx), weights
-
-    def _decode(payload):
-        table, weights = payload
-        out = dict(decode_fn(table))
-        out["_weight"] = weights
-        return out
-
-    plan_cache = None
-    if batch_cache is not None and dataset_fingerprint is not None:
-        # The caller supplies the fingerprint it already computed ONCE
-        # (Dataset construction / FolderDataPipeline init) — eval rebuilds
-        # this loader every eval_every epochs, and recomputing the
-        # fingerprint per rebuild was the churn this satellite removed.
-        # The eval=1 scope separates eval entries (they carry _weight)
-        # from train entries over the same rows.
-        from .cache import PlanCache, decode_fingerprint, plan_fingerprint
-
-        plan_cache = PlanCache(
-            batch_cache,
-            dataset_fingerprint,
-            lambda: plan_fingerprint(
-                decode=decode_fingerprint(decode_fn), eval=1,
-            ),
-        )
-    return DataPipeline(None, plan, _decode, device_put_fn, prefetch,
-                        read_fn=_read, producers=producers,
-                        buffer_pool=buffer_pool, plan_cache=plan_cache)
+    graph = LoaderGraph(
+        EvalSource(read_fn, num_rows, global_batch, process_index,
+                   process_count, index_pool=index_pool),
+        Decode(decode_fn),
+        Cache(batch_cache, dataset_fingerprint=dataset_fingerprint),
+        Buffers(buffer_pool),
+        Prefetch(prefetch, producers=producers),
+        DevicePut(device_put_fn),
+        InProcess(),
+    )
+    graph.compile()
+    return graph
 
 
 class MapStylePipeline:
@@ -661,6 +640,10 @@ class MapStylePipeline:
     ``get_safe_loader`` (``/root/reference/lance_map_style.py:54-69``);
     ``set_epoch`` reshuffles like ``DistributedSampler.set_epoch``
     (``lance_map_style.py:85-86``).
+
+    Since r16 this class is the runtime engine beneath a
+    :class:`~.graph.LoaderGraph` assembly (``MapStyleSource → Decode →
+    ... → InProcess``) — prefer composing the graph.
     """
 
     def __init__(
@@ -824,5 +807,41 @@ class MapStylePipeline:
             self._live_pipe = None
 
 
-def make_map_style_pipeline(dataset: Dataset, *args, **kwargs) -> MapStylePipeline:
-    return MapStylePipeline(dataset, *args, **kwargs)
+def make_map_style_pipeline(dataset: Dataset, *args, **kwargs) -> "LoaderGraph":
+    """Map-style loader as a :class:`~.graph.LoaderGraph` assembly —
+    accepts exactly :class:`MapStylePipeline`'s signature and streams
+    bit-identically to a direct construction."""
+    from .graph import (
+        Buffers,
+        Cache,
+        Decode,
+        DevicePut,
+        InProcess,
+        LoaderGraph,
+        MapStyleSource,
+        Pool,
+        Prefetch,
+    )
+    import inspect
+
+    bound = inspect.signature(MapStylePipeline.__init__).bind(
+        None, dataset, *args, **kwargs
+    )
+    bound.apply_defaults()
+    a = bound.arguments
+    graph = LoaderGraph(
+        MapStyleSource(dataset, a["batch_size"], a["process_index"],
+                       a["process_count"], shuffle=a["shuffle"],
+                       seed=a["seed"], epoch=a["epoch"],
+                       drop_last=a["drop_last"],
+                       index_pool=a["index_pool"]),
+        Decode(a["decode_fn"], columns=a["columns"]),
+        Cache(a["batch_cache"]),
+        Pool(a["workers"]),
+        Buffers(a["buffer_pool"]),
+        Prefetch(a["prefetch"], producers=a["producers"]),
+        DevicePut(a["device_put_fn"]),
+        InProcess(),
+    )
+    graph.compile()
+    return graph

@@ -316,6 +316,10 @@ class FleetLoader:
 
     Parameters mirror :class:`~..service.client.RemoteLoader` where they
     overlap; ``coordinator_addr`` replaces the single server address.
+
+    Since r16 this class is the runtime engine beneath a
+    :class:`~..data.graph.LoaderGraph` assembly (``LanceSource → Decode →
+    ... → FleetTransport``) — prefer composing the graph.
     """
 
     def __init__(

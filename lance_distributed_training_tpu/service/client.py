@@ -55,6 +55,10 @@ class RemoteLoader:
 
     Parameters mirror ``make_train_pipeline`` where they overlap; decode
     parameters live server-side (the service owns the decode plane).
+
+    Since r16 this class is the runtime engine beneath a
+    :class:`~..data.graph.LoaderGraph` assembly (``LanceSource → Decode →
+    ... → ServiceTransport``) — prefer composing the graph.
     """
 
     def __init__(

@@ -48,7 +48,7 @@ import time
 from typing import Optional
 
 from ..data.format import Dataset
-from ..data.samplers import assert_equal_step_counts, make_plan
+from ..data.graph import LanceSource
 from ..obs.lineage import make_lineage
 from ..obs.spans import span
 from ..utils.metrics import ServiceCounters
@@ -808,9 +808,10 @@ class DataService:
 
     def plan_for(self, req: dict):
         """This shard's epoch plan — identical to the in-process pipeline's
-        (same ``make_plan`` pure function, same equal-step validation across
-        ALL shards so the collective-deadlock guard still runs even though
-        training happens elsewhere)."""
+        (the same :meth:`~..data.graph.LanceSource.shard_plans` pure
+        function, same equal-step validation across ALL shards so the
+        collective-deadlock guard still runs even though training happens
+        elsewhere)."""
         key = (
             req["sampler_type"], int(req["batch_size"]),
             int(req["process_count"]), bool(req.get("shuffle")),
@@ -832,15 +833,15 @@ class DataService:
         with self._plans_lock:
             plans = self._plans.get(key)
             if plans is None:
-                rows = self.dataset.fragment_rows()
                 sampler, bs, count, shuffle, seed, epoch = key
-                plans = [
-                    make_plan(sampler, rows, bs, p, count,
-                              shuffle=shuffle, seed=seed, epoch=epoch)
-                    for p in range(count)
-                ]
-                if sampler not in ("full", "full_scan"):
-                    assert_equal_step_counts(plans, bs)
+                # The graph's source node is the ONE home of plan
+                # construction: the server asks it for every shard's plan
+                # exactly as the in-process compile does, so client and
+                # server can never drift.
+                plans = LanceSource(
+                    self.dataset, sampler, bs, 0, count,
+                    shuffle=shuffle, seed=seed, epoch=epoch,
+                ).shard_plans()
                 if len(self._plans) >= 8:  # old epochs: evict oldest entry
                     self._plans.pop(next(iter(self._plans)))
                 self._plans[key] = plans

@@ -37,7 +37,6 @@ import optax
 from flax.training import train_state
 
 from .data.format import Dataset
-from .data.pipeline import MapStylePipeline, make_train_pipeline
 from .models.tasks import Task, get_task
 from .obs.spans import span as obs_span
 from .parallel.mesh import (
@@ -725,21 +724,60 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             seq_axis="seq" if config.seq_parallelism > 1 else None,
         )
 
-    def _placed(loader):
-        return plane.wrap(loader) if plane is not None else loader
+    # Every arm is ONE LoaderGraph assembly (data/graph.py): the source/
+    # transport choice is the only thing that varies; decode boundary,
+    # cache, buffers, prefetch, and placement compose identically.
+    from .data.graph import (
+        Buffers,
+        Cache,
+        Decode,
+        DevicePut,
+        FleetTransport,
+        FolderSource,
+        InProcess,
+        LanceSource,
+        LoaderGraph,
+        MapStyleSource,
+        Place,
+        Pool,
+        Prefetch,
+        ServiceTransport,
+    )
+
+    def _assemble(source, decode_node, *mid):
+        nodes = [source, decode_node, *mid,
+                 Buffers(_loader_buffer_pool(config)), DevicePut(put)]
+        if plane is not None:
+            nodes.append(Place(plane))
+        graph = LoaderGraph(*nodes)
+        graph.compile()
+        return graph
+
     if config.data_service_addr or config.coordinator_addr:
         # Disaggregated input plane: decode runs in remote DataService
         # processes; this process only streams host batches and dispatches
         # device_put. The servers build the identical epoch Plan (same
-        # make_plan), so batches match local training bit-for-bit on the
-        # same seed — whether one server (RemoteLoader) or a coordinated
-        # fleet striped across N of them (FleetLoader).
-        common = dict(
-            sampler_type=config.sampler_type,
+        # LanceSource.shard_plans), so batches match local training
+        # bit-for-bit on the same seed — whether one server
+        # (ServiceTransport) or a coordinated fleet striped across N of
+        # them (FleetTransport).
+        source = LanceSource(
+            None,
+            config.sampler_type,
+            per_process,
+            process_index,
+            process_count,
             shuffle=config.shuffle,
             seed=config.seed,
             epoch=epoch,
-            prefetch=config.prefetch,
+            # Dataset-identity skew check (r13): when this host can read
+            # the dataset too, declare its fingerprint so a server backed
+            # by a DIFFERENT copy is rejected at connect time.
+            dataset_fingerprint=(
+                dataset.fingerprint() if dataset is not None else None
+            ),
+        )
+        decode_node = Decode(
             columns=getattr(decode, "required_columns", None),
             task_type=config.task_type,
             image_size=config.image_size,
@@ -751,57 +789,33 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             ),
             device_decode=config.device_decode,
             token_pack=config.token_pack,
-            # Dataset-identity skew check (r13): when this host can read
-            # the dataset too, declare its fingerprint so a server backed
-            # by a DIFFERENT copy is rejected at connect time.
-            dataset_fingerprint=(
-                dataset.fingerprint() if dataset is not None else None
-            ),
-            buffer_pool=_loader_buffer_pool(config),
         )
-        if config.coordinator_addr:
-            from .fleet.balancer import FleetLoader
-
-            loader = FleetLoader(
-                config.coordinator_addr,
-                per_process,
-                process_index,
-                process_count,
-                put,
-                **common,
-            )
-        else:
-            from .service.client import RemoteLoader
-
-            loader = RemoteLoader(
-                config.data_service_addr,
-                per_process,
-                process_index,
-                process_count,
-                put,
-                **common,
-            )
+        transport = (
+            FleetTransport(config.coordinator_addr)
+            if config.coordinator_addr
+            else ServiceTransport(config.data_service_addr)
+        )
+        loader = _assemble(source, decode_node,
+                           Prefetch(config.prefetch), transport)
         if len(loader) == 0:
             raise ValueError(
                 "empty plan from data service: dataset smaller than one "
                 f"global batch ({config.batch_size})"
             )
-        return _placed(loader)
+        return loader
     if config.filter and config.data_format != "columnar":
         raise ValueError("filter= needs the columnar store (data_format="
                          "'columnar'); folder trees have no row predicates")
+    prefetch_node = Prefetch(config.prefetch,
+                             producers=config.producer_threads)
     if config.data_format == "folder":
         # Control arm: plain files, no columnar store (torch_version/ twin,
         # reference README.md:286-290).
-        from .data.folder import FolderDataPipeline
-
-        loader = FolderDataPipeline(
+        source = FolderSource(
             config.dataset_path,
             per_process,
             process_index,
             process_count,
-            decode,
-            put,
             loader_style=config.loader_style,
             # Map-style always reshuffles (DistributedSampler semantics);
             # the iterable arm's batch-order shuffle is opt-in, matching the
@@ -809,13 +823,10 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             shuffle=True if config.loader_style == "map" else config.shuffle,
             seed=config.seed,
             epoch=epoch,
-            prefetch=config.prefetch,
-            workers=workers,
-            producers=config.producer_threads,
-            buffer_pool=_loader_buffer_pool(config),
-            batch_cache=batch_cache,
             dataset_fingerprint=folder_fp,
         )
+        loader = _assemble(source, Decode(decode), Cache(batch_cache),
+                           Pool(workers), prefetch_node, InProcess())
         if len(loader) == 0:
             raise ValueError("folder smaller than one global batch")
         if (
@@ -827,7 +838,7 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
                 f"num_classes={config.num_classes}; out-of-range labels "
                 "would be silently clamped by the XLA gather"
             )
-        return _placed(loader)
+        return loader
     columns = getattr(decode, "required_columns", None)
     if config.filter and config.loader_style != "map":
         raise ValueError(
@@ -845,48 +856,35 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
                 f"filter {config.filter!r} keeps {len(index_pool)} rows — "
                 f"fewer than one global batch ({config.batch_size})"
             )
-        loader = MapStylePipeline(
+        source = MapStyleSource(
             dataset,
             per_process,
             process_index,
             process_count,
-            decode,
-            put,
             seed=config.seed,
             epoch=epoch,
-            prefetch=config.prefetch,
-            workers=workers,
-            producers=config.producer_threads,
-            columns=columns,
             index_pool=index_pool,
-            buffer_pool=_loader_buffer_pool(config),
-            batch_cache=batch_cache,
         )
     else:
-        loader = make_train_pipeline(
+        source = LanceSource(
             dataset,
             config.sampler_type,
             per_process,
             process_index,
             process_count,
-            decode,
-            put,
-            prefetch=config.prefetch,
-            workers=workers,
-            producers=config.producer_threads,
             shuffle=config.shuffle,
             seed=config.seed,
             epoch=epoch,
-            columns=columns,
-            buffer_pool=_loader_buffer_pool(config),
-            batch_cache=batch_cache,
         )
+    loader = _assemble(source, Decode(decode, columns=columns),
+                       Cache(batch_cache), Pool(workers), prefetch_node,
+                       InProcess())
     if len(loader) == 0:
         raise ValueError(
             "empty plan: dataset smaller than one global batch "
             f"({dataset.count_rows()} rows, global batch {config.batch_size})"
         )
-    return _placed(loader)
+    return loader
 
 
 def _split_val_pool(config: TrainConfig, dataset, index_pool):

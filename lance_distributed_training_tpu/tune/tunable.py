@@ -12,8 +12,10 @@ package declares both.
 
 Components expose their knobs via a ``tunables() -> list[Tunable]`` method
 (``WorkerPool``, ``DataPipeline``, ``MapStylePipeline``, ``RemoteLoader``,
-``FleetLoader``, ``BufferPool``, ``PlacementPlane``, ``PlacedLoader``); the
-trainer gathers them with :func:`collect_tunables` and hands the set to the
+``FleetLoader``, ``BufferPool``, ``PlacementPlane``, ``PlacedLoader`` — and
+since r16 ``LoaderGraph``, the graph root whose single ``tunables()``
+aggregation is what the trainer registers); the trainer gathers them with
+:func:`collect_tunables` and hands the set to the
 :class:`~.controller.AutoTuner`. Nothing registers globally: with
 ``--no_autotune`` no Tunable is ever constructed and the pipeline runs the
 exact fixed-knob configuration it always did.

@@ -164,6 +164,52 @@ def main() -> None:
     assert segs, "ram budget 0 must have spilled segments to disk"
     print(f"leak sanitizer ok: 0 outstanding leases, "
           f"{len(segs)} committed segments, no temp strays")
+
+    # -- 4: explicitly-composed loader graph arm --------------------------
+    # The r16 subsystem: the same cached stream assembled node by node
+    # (LanceSource -> Decode -> Cache -> InProcess) must be bit-identical
+    # to the legacy factory path, cold AND warm.
+    from lance_distributed_training_tpu.data.cache import BatchCache
+    from lance_distributed_training_tpu.data.decode import (
+        ImageClassificationDecoder,
+    )
+    from lance_distributed_training_tpu.data.graph import (
+        Cache,
+        Decode,
+        InProcess,
+        LanceSource,
+        LoaderGraph,
+    )
+    from lance_distributed_training_tpu.data.pipeline import (
+        make_train_pipeline,
+    )
+    from lance_distributed_training_tpu.obs.registry import MetricsRegistry
+    from lance_distributed_training_tpu.utils.chaos import batch_digest
+
+    reg = MetricsRegistry()
+    graph_cache = BatchCache(cache_dir=str(tmp / "graph-cache"),
+                             ram_budget_mb=8, disk_budget_mb=64,
+                             registry=reg)
+
+    def composed():
+        return LoaderGraph(
+            LanceSource(ds, "batch", 16, 0, 1),
+            Decode(ImageClassificationDecoder(image_size=SIZE)),
+            Cache(graph_cache), InProcess(),
+        )
+
+    legacy = [batch_digest(b) for b in make_train_pipeline(
+        ds, "batch", 16, 0, 1, ImageClassificationDecoder(image_size=SIZE),
+    )]
+    assert [batch_digest(b) for b in composed()] == legacy, (
+        "composed graph diverged from the legacy factory stream"
+    )
+    assert [batch_digest(b) for b in composed()] == legacy
+    hits = reg.counter("cache_hit_total").value
+    assert hits == len(legacy), (hits, len(legacy))
+    graph_cache.close()
+    print(f"composed-graph arm ok: {len(legacy)} steps bit-identical, "
+          f"warm epoch {hits} pure hits")
     print("batch-cache smoke ok")
 
 

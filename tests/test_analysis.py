@@ -3382,3 +3382,83 @@ def test_ldt1501_content_sized_allocations_pass(tmp_path):
             return page
     """}, hot_paths=["*"])
     assert [f for f in findings if f.rule == "LDT1501"] == []
+
+
+# -- LDT1601 graph hygiene ----------------------------------------------------
+
+
+def test_ldt1601_flags_engine_construction_on_hot_path(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        from lance_distributed_training_tpu.data.pipeline import DataPipeline
+
+        def build(ds, plan, decode):
+            return DataPipeline(ds, plan, decode, None, 2)
+    """}, hot_paths=["*"])
+    hits = [f for f in findings if f.rule == "LDT1601"]
+    assert len(hits) == 1
+    assert "LoaderGraph" in hits[0].message
+
+
+def test_ldt1601_flags_attribute_qualified_engines(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        from lance_distributed_training_tpu import fleet, service
+
+        def build(addr, batch):
+            a = service.client.RemoteLoader(addr, batch, 0, 1)
+            b = fleet.balancer.FleetLoader(addr, batch, 0, 1)
+            return a, b
+    """}, hot_paths=["*"])
+    assert [f.rule for f in findings
+            if f.rule == "LDT1601"] == ["LDT1601", "LDT1601"]
+
+
+def test_ldt1601_exempts_engine_home_modules(tmp_path):
+    """data/pipeline.py + data/folder.py legitimately build inner engines,
+    and data/graph.py is the one compile seam allowed to build all five."""
+    src = """\
+        def rebuild(ds, plan, decode):
+            return DataPipeline(ds, plan, decode, None, 2)
+    """
+    findings = run_rules(tmp_path, {
+        "data/pipeline.py": src,
+        "data/folder.py": src,
+        "data/graph.py": src,
+        "service/client.py": src,
+        "fleet/balancer.py": src,
+    }, hot_paths=["*"])
+    assert [f for f in findings if f.rule == "LDT1601"] == []
+
+
+def test_ldt1601_silent_off_hot_paths(tmp_path):
+    findings = run_rules(tmp_path, {"scripts/bench.py": """\
+        def bench(ds, plan, decode):
+            return MapStylePipeline(ds, 16, 0, 1, decode, None)
+    """}, hot_paths=["trainer.py"])
+    assert [f for f in findings if f.rule == "LDT1601"] == []
+
+
+def test_ldt1601_loader_graph_composition_passes(tmp_path):
+    findings = run_rules(tmp_path, {"m.py": """\
+        from lance_distributed_training_tpu.data.graph import (
+            Decode, InProcess, LanceSource, LoaderGraph,
+        )
+
+        def build(ds, decode):
+            graph = LoaderGraph(
+                LanceSource(ds, "batch", 16, 0, 1), Decode(decode),
+                InProcess(),
+            )
+            graph.compile()
+            return graph
+    """}, hot_paths=["*"])
+    assert [f for f in findings if f.rule == "LDT1601"] == []
+
+
+def test_ldt1601_repo_hot_paths_are_graph_clean():
+    """The repo's own hot-path modules compose graphs: the only engine
+    constructions live in the exempt home modules + data/graph.py."""
+    from lance_distributed_training_tpu.analysis.config import load_config
+
+    config = load_config(str(REPO_ROOT))
+    findings = analyze(str(REPO_ROOT), config)
+    assert [f for f in findings if f.rule == "LDT1601"] == []
