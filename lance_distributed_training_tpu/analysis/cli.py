@@ -81,6 +81,14 @@ def build_check_parser() -> argparse.ArgumentParser:
                         "orphan-read at that field (a writer exists "
                         "outside the static view); a message exercised "
                         "without the field corroborates it")
+    p.add_argument("--compile-witness", default=None, metavar="PATH",
+                   help="runtime compile/transfer witness JSON (emitted by "
+                        "a test run under LDT_COMPILE_SANITIZER=1, "
+                        "utils/compiletrack.py): a jit site that "
+                        "demonstrably recompiled after warmup corroborates "
+                        "the LDT1703 hazard there; one exercised with a "
+                        "single steady-state signature marks it "
+                        "witness_pruned")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule registry and exit")
     return p
@@ -155,6 +163,38 @@ def load_leak_witness(path: str, root: str) -> dict:
     return {"sites": sites}
 
 
+def load_compile_witness(path: str, root: str) -> dict:
+    """Parse a ``utils/compiletrack.py`` witness file into the structure the
+    LDT1703 rule and the mesh model's receipt consume: ``{"compiles":
+    {"path:line": {"calls": n, "compiles": n, "post_warmup": n}},
+    "transfers": {"h2d"|"d2h": {"path:line": {"count": n, "bytes": n}}}}``
+    with sites relativized to ``root`` — the same join-key discipline as
+    the lock/leak witnesses. Every count must parse as an int HERE so a
+    malformed file raises into the caller's unreadable-witness exit-2
+    path, never a mid-analysis traceback."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    compiles = {
+        _rel_site(site, root): {
+            "calls": int(entry["calls"]),
+            "compiles": int(entry["compiles"]),
+            "post_warmup": int(entry["post_warmup"]),
+        }
+        for site, entry in data.get("compiles", {}).items()
+    }
+    transfers = {
+        str(direction): {
+            _rel_site(site, root): {
+                "count": int(entry["count"]),
+                "bytes": int(entry["bytes"]),
+            }
+            for site, entry in table.items()
+        }
+        for direction, table in data.get("transfers", {}).items()
+    }
+    return {"compiles": compiles, "transfers": transfers}
+
+
 def check_main(argv: Optional[Sequence[str]] = None,
                out=None) -> int:
     """The ``ldt check`` entry point. Returns the process exit status."""
@@ -209,6 +249,18 @@ def check_main(argv: Optional[Sequence[str]] = None,
             out.write(
                 f"ldt check: unreadable wire witness "
                 f"{args.wire_witness}: {exc}\n"
+            )
+            return 2
+    if args.compile_witness:
+        try:
+            config.compile_witness = load_compile_witness(
+                args.compile_witness, root
+            )
+        except (OSError, ValueError, KeyError, TypeError,
+                AttributeError) as exc:
+            out.write(
+                f"ldt check: unreadable compile witness "
+                f"{args.compile_witness}: {exc}\n"
             )
             return 2
 
@@ -292,6 +344,20 @@ def check_main(argv: Optional[Sequence[str]] = None,
                 f"tuples match the static schema over "
                 f"{wire_summary['frames']} frames{suffix}\n"
             )
+        compile_summary = timing.get("compile_witness")
+        if compile_summary is not None:
+            # Same receipt discipline for the compile witness: runtime jit
+            # sites mapped onto the static mesh model's def-site candidates,
+            # plus the transfer-event totals the CI stage eyeballs.
+            out.write(
+                f"ldt check: compile witness: "
+                f"{compile_summary['matched_sites']}/"
+                f"{compile_summary['runtime_sites']} runtime jit sites "
+                f"match static jit sites, "
+                f"{compile_summary['recompiled_sites']} recompiled "
+                f"post-warmup, {compile_summary['h2d_events']} H2D / "
+                f"{compile_summary['d2h_events']} D2H transfer events\n"
+            )
     return 1 if any(not f.witness_pruned for f in new) else 0
 
 
@@ -327,6 +393,11 @@ def build_graph_parser() -> argparse.ArgumentParser:
                         "(data/graph.py): the five canonical LoaderGraph "
                         "shapes as node chains, with cursor owners and "
                         "tunable-bearing nodes marked")
+    p.add_argument("--mesh", action="store_true",
+                   help="also render the device-semantics model "
+                        "(analysis/meshmodel.py): jitted kernels with "
+                        "their static/donated argument sets, and every "
+                        "literal mesh-axis reference grouped per axis")
     return p
 
 
@@ -366,6 +437,11 @@ def graph_main(argv: Optional[Sequence[str]] = None, out=None) -> int:
         from .protomodel import build_proto_model
 
         proto = build_proto_model(program, config)
+    mesh = None
+    if args.mesh:
+        from .meshmodel import build_mesh_model
+
+        mesh = build_mesh_model(program, config)
     loaders = None
     if args.loader:
         # Spec-only canonical graphs: describe() never compiles, so this
@@ -500,6 +576,48 @@ def graph_main(argv: Optional[Sequence[str]] = None, out=None) -> int:
                         f'  "msg:{name}" -> "fn:{r}" '
                         '[color="#2563eb"];\n'
                     )
+        if mesh is not None:
+            # Jitted kernels as double-octagon nodes (static/donated args in
+            # the label), mesh axes as filled circles, axis-reference edges
+            # labelled with their context; undeclared axes render RED.
+            declared = set(mesh.mesh_axes)
+            for axis in sorted(
+                declared | {r.axis for r in mesh.axis_refs}
+            ):
+                color = "#fee2e2" if axis not in declared else "#cffafe"
+                out.write(
+                    f'  "axis:{axis}" [label="{axis}", shape=circle, '
+                    f'style=filled, fillcolor="{color}"];\n'
+                )
+            for i, site in enumerate(mesh.jit_sites):
+                label = f"{site.kind} {site.name}"
+                if site.static_argnames or site.static_argnums:
+                    statics = list(site.static_argnames) + [
+                        f"#{n}" for n in site.static_argnums
+                    ]
+                    label += "\\nstatic: " + ", ".join(statics)
+                if site.donate_argnums:
+                    label += "\\ndonate: " + ", ".join(
+                        f"#{n}" for n in site.donate_argnums
+                    )
+                    if site.donate_conditional:
+                        label += " (conditional)"
+                out.write(
+                    f'  "jit:{i}" [label="{label}\\n'
+                    f'{site.module}:{site.line}", shape=doubleoctagon, '
+                    'style=filled, fillcolor="#fde68a"];\n'
+                )
+            by_axis: dict = {}
+            for ref in mesh.axis_refs:
+                by_axis.setdefault(ref.axis, []).append(ref)
+            for axis, refs in sorted(by_axis.items()):
+                contexts = sorted({r.context for r in refs})
+                out.write(
+                    f'  "axisrefs:{axis}" [label="{len(refs)} refs\\n'
+                    f'{", ".join(contexts)}", shape=plaintext];\n'
+                    f'  "axisrefs:{axis}" -> "axis:{axis}" '
+                    '[style=dashed, color="#0891b2"];\n'
+                )
         if loaders is not None:
             # One cluster per canonical shape: the node chain left to
             # right, cursor owner double-bordered, tunable bearers dashed.
@@ -600,6 +718,41 @@ def graph_main(argv: Optional[Sequence[str]] = None, out=None) -> int:
                         mark += f" >={gate}"
                     parts.append(f + (f" [{mark.strip()}]" if mark else ""))
                 out.write(f"  msg {name}: {', '.join(parts)}\n")
+        if mesh is not None:
+            out.write(
+                f"  mesh model: {len(mesh.jit_sites)} jit sites, "
+                f"{len(mesh.axis_refs)} axis references over axes "
+                f"({', '.join(mesh.mesh_axes)})\n"
+            )
+            for site in mesh.jit_sites:
+                marks = []
+                if site.static_argnames or site.static_argnums:
+                    marks.append("static: " + ", ".join(
+                        list(site.static_argnames)
+                        + [f"#{n}" for n in site.static_argnums]
+                    ))
+                if site.donate_argnums:
+                    don = "donate: " + ", ".join(
+                        f"#{n}" for n in site.donate_argnums
+                    )
+                    if site.donate_conditional:
+                        don += " (conditional)"
+                    marks.append(don)
+                tail = f" [{'; '.join(marks)}]" if marks else ""
+                out.write(
+                    f"  {site.kind} {site.name} "
+                    f"({site.module}:{site.line}){tail}\n"
+                )
+            by_axis: dict = {}
+            for ref in mesh.axis_refs:
+                by_axis.setdefault(ref.axis, []).append(ref)
+            declared = set(mesh.mesh_axes)
+            for axis, refs in sorted(by_axis.items()):
+                flag = "" if axis in declared else " [UNDECLARED]"
+                out.write(
+                    f"  axis {axis}{flag}: {len(refs)} references "
+                    f"({', '.join(sorted({r.context for r in refs}))})\n"
+                )
         if loaders is not None:
             out.write(
                 f"  loader graph model (data/graph.py): {len(loaders)} "

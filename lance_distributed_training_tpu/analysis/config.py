@@ -159,6 +159,44 @@ class CheckConfig:
     # CLI, never from TOML — {"sites": {"path:line": {"acquired": n,
     # "released": n, "leaked": n}}} with root-relative sites.
     leak_witness: Optional[dict] = None
+    # LDT1701: the declared mesh-axis vocabulary — every literal axis name
+    # in a PartitionSpec or collective must come from this list. Seeded
+    # from parallel/mesh.py's get_mesh (data, model, seq, pipe). TOML:
+    # ``mesh-axes``.
+    mesh_axes: List[str] = dataclasses.field(
+        default_factory=lambda: ["data", "model", "seq", "pipe"]
+    )
+    # LDT1703: the quantized funnels — call-name globs (matched against the
+    # callee's dotted tail) through which a .shape/len()-derived value may
+    # legitimately reach a jit static position, because the funnel clamps
+    # it to a short ladder (coeff_chunk actuation, pack_rows_quantum
+    # rounding). TOML: ``static-funnels``.
+    static_funnels: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "coeff_chunk", "pack_rows_quantum", "rows_multiple",
+            "*_quantum", "*_bucket",
+        ]
+    )
+    # LDT1704: function-name globs (bare name or dotted-qualname tail)
+    # allowed to host-sync deliberately — declared D2H doors. TOML:
+    # ``sync-funnels``.
+    sync_funnels: List[str] = dataclasses.field(default_factory=list)
+    # LDT1704: the compute-plane hot modules where a stray host sync
+    # serialises the dispatch stream (hot_paths above is the DATA plane's
+    # copy discipline — different contract, different module set). TOML:
+    # ``device-hot-paths``.
+    device_hot_paths: List[str] = dataclasses.field(
+        default_factory=lambda: [
+            "lance_distributed_training_tpu/trainer.py",
+            "lance_distributed_training_tpu/ops/*",
+            "lance_distributed_training_tpu/parallel/*",
+        ]
+    )
+    # LDT1703 runtime witness (``ldt check --compile-witness``): set by the
+    # CLI, never from TOML — {"compiles": {"path:line": {"calls": n,
+    # "distinct": k, "post_warmup": m}}, "transfers": {...}} recorded by
+    # utils/compiletrack.py under LDT_COMPILE_SANITIZER=1.
+    compile_witness: Optional[dict] = None
     # LDT701: the hot-path modules where materialising copies
     # (.to_pylist(), bytes(view[...])) undo the zero-copy batch plane.
     hot_paths: List[str] = dataclasses.field(
@@ -219,6 +257,10 @@ def load_config(root: str) -> CheckConfig:
         "resources": "resources",
         "content-paths": "content_paths",
         "taint-sources": "taint_sources",
+        "mesh-axes": "mesh_axes",
+        "static-funnels": "static_funnels",
+        "sync-funnels": "sync_funnels",
+        "device-hot-paths": "device_hot_paths",
     }
     for key, attr in mapping.items():
         if key in section:

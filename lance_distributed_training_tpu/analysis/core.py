@@ -79,9 +79,10 @@ _SUPPRESS_RE = re.compile(
 # content computation, a payload field one peer forgot), so an unexplained
 # per-line ignore is exactly the "trust me" a reviewer cannot review.
 # Suppressions for the concurrency (LDT10xx), ownership (LDT12xx), purity
-# (LDT13xx), and wire-protocol (LDT14xx) families require a reason string:
+# (LDT13xx), wire-protocol (LDT14xx), and device-semantics (LDT17xx)
+# families require a reason string:
 #     # ldt: ignore[LDT1002] -- GIL-atomic monotonic cursor, torn reads ok
-_REASON_REQUIRED_RE = re.compile(r"LDT1[0234]\d\d$")
+_REASON_REQUIRED_RE = re.compile(r"LDT1[02347]\d\d$")
 
 
 def _parse_suppressions(lines: Sequence[str]) -> Dict[int, tuple]:
@@ -468,6 +469,23 @@ def analyze_project(root: str, config, timing: Optional[dict] = None):
                         if int(v.get("leaked", 0)) > 0
                     ),
                 }
+        if any(
+            getattr(rule, "uses_mesh_model", False)
+            for rule in rules.values()
+        ):
+            from .meshmodel import build_mesh_model
+
+            t_mesh = _time.perf_counter()
+            mesh = build_mesh_model(program, config)
+            model_ms["mesh"] = round(
+                (_time.perf_counter() - t_mesh) * 1e3, 3
+            )
+            compile_w = getattr(config, "compile_witness", None)
+            if compile_w is not None and timing is not None:
+                # The corroboration receipt the CI compile-witness stage
+                # asserts on: how much of the runtime compile/transfer
+                # evidence maps onto static jit sites.
+                timing["compile_witness"] = mesh.witness_receipt(compile_w)
         if timing is not None:
             timing["model_build_ms"] = model_ms
     for rule in rules.values():

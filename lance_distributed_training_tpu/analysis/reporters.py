@@ -88,6 +88,12 @@ def render_json(
     --wire-witness`` ran): {"observed_fields", "matched_fields",
     "frames"} — how much of the runtime (msg, field) wire traffic maps
     onto the static payload schema.
+
+    Additive v2 fields (r17): ``model_build_ms`` gains ``"mesh"`` (the
+    device-semantics model), and ``compile_witness`` (only when ``ldt
+    check --compile-witness`` ran): {"runtime_sites", "matched_sites",
+    "recompiled_sites", "h2d_events", "d2h_events"} — how much of the
+    runtime compile/transfer evidence maps onto the static jit sites.
     """
     records = []
     for f in findings:
@@ -118,5 +124,7 @@ def render_json(
         payload["leak_witness"] = timing["leak_witness"]
     if (timing or {}).get("wire_witness") is not None:
         payload["wire_witness"] = timing["wire_witness"]
+    if (timing or {}).get("compile_witness") is not None:
+        payload["compile_witness"] = timing["compile_witness"]
     json.dump(payload, out, indent=2)
     out.write("\n")

@@ -16,6 +16,7 @@ from . import (  # noqa: F401  (import for registration side effect)
     graph,
     jit_purity,
     lockorder,
+    meshrules,
     obs,
     ownership,
     padding,

@@ -237,7 +237,7 @@ class ImageClassificationDecoder:
                 for i in np.nonzero(failed)[0]:
                     images[i] = self._decode_one(col[int(i)].as_py())
             return images
-        return self.decode_payloads(col.to_pylist())
+        return self.decode_payloads(col.to_pylist())  # ldt: ignore[LDT701] -- deliberate PIL fallback arm: tolerant row-by-row decode needs Python bytes; the zero-copy path above handles the native decoder
 
     def __call__(
         self, batch: Union[pa.RecordBatch, pa.Table]

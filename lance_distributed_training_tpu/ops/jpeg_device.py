@@ -243,3 +243,13 @@ def make_batch_transform(out_size: int = 224):
         return out
 
     return transform
+
+
+# Compile-witness funnel: when the sanitizer env flag is set at import time
+# the decode kernel records every invocation's abstract signature under its
+# def site (recovered via __wrapped__), so `ldt check --compile-witness` can
+# corroborate or prune LDT1703 hazards on the decode path.
+from ..utils import compiletrack  # noqa: E402 — deliberate bottom import
+
+if compiletrack.enabled():
+    decode_coeff_batch = compiletrack.wrap_jit(decode_coeff_batch)

@@ -223,3 +223,14 @@ def make_pack_transform(batch_sharding=None):
         return out
 
     return transform
+
+
+# Compile-witness funnel: same module-bottom wrap discipline as
+# ops/jpeg_device.py — pack/unpack record per-def-site trace signatures when
+# LDT_COMPILE_SANITIZER=1 so the CI gate can assert zero steady-state
+# recompiles on the packing path.
+from ..utils import compiletrack  # noqa: E402 — deliberate bottom import
+
+if compiletrack.enabled():
+    pack_token_batch = compiletrack.wrap_jit(pack_token_batch)
+    unpack_token_batch = compiletrack.wrap_jit(unpack_token_batch)

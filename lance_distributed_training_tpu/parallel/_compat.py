@@ -32,6 +32,8 @@ from __future__ import annotations
 import jax
 from jax import lax
 
+from ..utils import compiletrack
+
 __all__ = [
     "shard_map",
     "pcast",
@@ -44,7 +46,19 @@ __all__ = [
 # Placement primitives (see module docstring). Plain aliases on every jax
 # this container runs; the try/except keeps package import alive on the
 # early-0.4 releases where assembly lived under jax.experimental.array.
-device_put = jax.device_put
+# ``device_put`` doubles as the compile/transfer witness's one H2D door:
+# with LDT_COMPILE_SANITIZER=1 every placement through the shim is counted
+# per caller site (depth=3 — the user's ``device_put(`` line), which is what
+# lets ``ldt check --compile-witness`` report real H2D traffic next to the
+# static LDT801 funnel discipline.
+_raw_device_put = jax.device_put
+
+
+def device_put(x, *args, **kwargs):
+    if compiletrack.enabled():
+        compiletrack.track_transfer(
+            "h2d", getattr(x, "nbytes", 0) or 0, depth=3)
+    return _raw_device_put(x, *args, **kwargs)
 
 try:
     make_array_from_single_device_arrays = (

@@ -514,7 +514,7 @@ def decode_batch(payload, with_lineage: bool = False,
     if len(view) < offset + meta_len:
         raise ProtocolError("batch frame truncated inside meta")
     try:
-        meta = json.loads(bytes(view[offset : offset + meta_len]))
+        meta = json.loads(bytes(view[offset : offset + meta_len]))  # ldt: ignore[LDT701] -- json.loads cannot take a memoryview slice; the copy is the small control meta, never tensor payload
     except (ValueError, UnicodeDecodeError) as exc:
         raise ProtocolError(f"undecodable batch meta: {exc}")
     offset += meta_len

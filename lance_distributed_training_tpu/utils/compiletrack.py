@@ -1,0 +1,319 @@
+"""Runtime compile/transfer sanitizer — the witness half of LDT1703.
+
+The static mesh model (``analysis/meshmodel.py``) infers "a shape- or
+length-derived value reaches a jit static argument or a Python branch
+inside a jitted content-path function" from the AST. Like the lock and
+leak models it has two failure modes: hazards it cannot see (a shape
+laundered through a helper the dataflow scan does not follow) and
+hazards that never fire (the value is quantized upstream and only ever
+takes one concrete value). This module closes both with evidence: an
+opt-in (``LDT_COMPILE_SANITIZER=1``) recorder that the package's jit
+funnels route through — :func:`wrap_jit` counts distinct abstract
+signatures per *jit definition site* (``abspath:lineno`` of the wrapped
+function's def — the join key the static jit-site records map onto),
+and :func:`track_transfer` counts H2D/D2H events through the
+``parallel/_compat.py`` ``device_put`` door and the trainer's deliberate
+drain points. At process exit the test harness dumps a witness JSON
+(``tests/conftest.py``, mirroring the lock/leak witnesses) that
+``ldt check --compile-witness <path>`` cross-checks:
+
+* a static LDT1703 hazard whose jit site shows post-warmup recompiles
+  is *reproduced* — the finding says so, with the count;
+* one whose site was exercised (called more than once) and never
+  recompiled after warmup is marked ``witness_pruned`` (rendered, not
+  failing, never baselined);
+* sites the run never touched prove nothing and change nothing — the
+  same strict-evidence discipline as ``utils/lockorder.py``.
+
+"Warmup" is the first call per site: the first trace is the price of
+admission and never counts. A *post-warmup* compile is a NEW abstract
+signature observed strictly after the first call — exactly the
+steady-state recompile the static rule predicts. The abstract key is
+duck-typed shape/dtype structure (see :func:`_abstract_key`) so the
+recorder never imports jax and works on any array-like pytree.
+
+The recorder is deliberately dumb and cheap: a dict update under one
+raw lock per call, no I/O until :func:`dump`. Hooks are two-line
+``if compiletrack.enabled():`` guards in ``trainer.py`` /
+``parallel/_compat.py`` / ``ops/*`` — cold by default,
+measurable-but-harmless at test-suite scale, which is exactly where the
+witness is collected (``scripts/ci.sh`` runs tier-1 under the
+sanitizer, then feeds the witness back into the gate and asserts a
+short train smoke shows ZERO post-warmup compiles).
+
+Stdlib-only, no package imports: the analyzer side only ever READS the
+JSON this writes, and must do so even when the training package cannot
+import.
+
+Knobs::
+
+    LDT_COMPILE_SANITIZER=1      # the jit funnels start recording
+    LDT_COMPILE_WITNESS_PATH=…   # dump target (default ./compile-witness.json)
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import _thread
+from typing import Callable, Dict, List, Optional
+
+__all__ = [
+    "enabled",
+    "enable",
+    "disable",
+    "wrap_jit",
+    "track_call",
+    "track_transfer",
+    "sites",
+    "transfers",
+    "reset",
+    "snapshot",
+    "restore",
+    "dump",
+    "ENV_FLAG",
+    "ENV_PATH",
+]
+
+ENV_FLAG = "LDT_COMPILE_SANITIZER"
+ENV_PATH = "LDT_COMPILE_WITNESS_PATH"
+DEFAULT_WITNESS_PATH = "compile-witness.json"
+
+# Recorder state. A RAW lock (the sanitizer must never observe itself
+# through the lock sanitizer's shim); critical sections are dict updates
+# only, never I/O.
+_state_lock = _thread.allocate_lock()
+# site -> {"calls": int, "keys": [abstract-key str, in first-seen order],
+#          "post_warmup": int}
+_sites: Dict[str, dict] = {}
+# direction ("h2d"|"d2h") -> site -> [count, bytes]
+_transfers: Dict[str, Dict[str, List[int]]] = {"h2d": {}, "d2h": {}}
+# Evaluated once per process: hooks are two attribute reads when off.
+_enabled = os.environ.get(ENV_FLAG) == "1"
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    """Turn the recorder on in-process (tests; production opts in via the
+    env flag so spawned workers inherit it)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def _caller_site(depth: int) -> str:
+    frame = sys._getframe(depth)
+    return f"{frame.f_code.co_filename}:{frame.f_lineno}"
+
+
+def _def_site(fn: Callable) -> Optional[str]:
+    """``abspath:firstlineno`` of the innermost user function — the static
+    jit-site join key. Unwraps ``__wrapped__`` chains (jax.jit sets it)
+    and falls back to the callable's own ``__code__``; returns None for
+    C callables, which simply record under an opaque site."""
+    seen = 0
+    obj = fn
+    while hasattr(obj, "__wrapped__") and seen < 8:
+        obj = obj.__wrapped__
+        seen += 1
+    code = getattr(obj, "__code__", None)
+    if code is None:
+        code = getattr(fn, "__code__", None)
+    if code is None:
+        return None
+    return f"{code.co_filename}:{code.co_firstlineno}"
+
+
+def _abstract_key(obj: object, depth: int = 0) -> object:
+    """Duck-typed abstract signature of one argument: arrays collapse to
+    ``(shape, dtype)`` — the trace-cache key axis that matters — while
+    plain Python values keep their VALUE (a changed static scalar is a
+    retrace, which is the entire point). Containers recurse; unhashable
+    leftovers collapse to their type name."""
+    if depth > 6:
+        return type(obj).__name__
+    shape = getattr(obj, "shape", None)
+    dtype = getattr(obj, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("ary", tuple(shape), str(dtype))
+    if isinstance(obj, (tuple, list)):
+        return (type(obj).__name__,) + tuple(
+            _abstract_key(v, depth + 1) for v in obj
+        )
+    if isinstance(obj, dict):
+        return ("dict",) + tuple(
+            (k, _abstract_key(v, depth + 1)) for k, v in sorted(obj.items(), key=repr)
+        )
+    fields = getattr(obj, "__dataclass_fields__", None)
+    if fields is not None:
+        return (type(obj).__name__,) + tuple(
+            (name, _abstract_key(getattr(obj, name, None), depth + 1))
+            for name in fields
+        )
+    try:
+        hash(obj)
+    except TypeError:
+        return type(obj).__name__
+    return obj
+
+
+def track_call(site: str, args: tuple, kwargs: dict) -> None:
+    """Record one invocation of a jitted callable at ``site``. A new
+    abstract signature strictly after the site's first call counts as a
+    post-warmup compile."""
+    key = repr(_abstract_key((args, tuple(sorted(kwargs.items(), key=repr)))))
+    with _state_lock:
+        rec = _sites.setdefault(
+            site, {"calls": 0, "keys": [], "post_warmup": 0}
+        )
+        first_call = rec["calls"] == 0
+        rec["calls"] += 1
+        if key not in rec["keys"]:
+            rec["keys"].append(key)
+            if not first_call:
+                rec["post_warmup"] += 1
+
+
+def wrap_jit(jitted: Callable, fn: Optional[Callable] = None) -> Callable:
+    """Wrap an already-jitted callable so every invocation is recorded
+    under the DEF site of the underlying user function (``fn`` when the
+    caller still holds it, else recovered via ``__wrapped__``). The
+    funnels guard the call (``if compiletrack.enabled(): jitted =
+    compiletrack.wrap_jit(jitted, step)``) so production pays nothing."""
+    site = _def_site(fn if fn is not None else jitted)
+    if site is None:
+        site = f"<opaque:{getattr(jitted, '__name__', type(jitted).__name__)}>"
+
+    @functools.wraps(jitted)
+    def _recorded(*args, **kwargs):
+        if _enabled:
+            track_call(site, args, kwargs)
+        return jitted(*args, **kwargs)
+
+    _recorded.__ldt_compile_site__ = site
+    return _recorded
+
+
+def track_transfer(direction: str, nbytes: int, depth: int = 2) -> None:
+    """Record one host↔device transfer event. ``direction`` is ``"h2d"``
+    or ``"d2h"``; ``depth`` names the frame whose line is the transfer
+    site (2 = the line invoking this hook, 3 = its caller — the
+    ``device_put`` shim passes 3 so the site is the user's call line)."""
+    site = _caller_site(depth)
+    with _state_lock:
+        rec = _transfers.setdefault(direction, {}).setdefault(site, [0, 0])
+        rec[0] += 1
+        rec[1] += int(nbytes)
+
+
+def sites() -> Dict[str, dict]:
+    """Per-jit-site compile counters as the witness schema reports them."""
+    with _state_lock:
+        return {
+            site: {
+                "calls": rec["calls"],
+                "compiles": len(rec["keys"]),
+                "post_warmup": rec["post_warmup"],
+            }
+            for site, rec in _sites.items()
+        }
+
+
+def transfers() -> Dict[str, Dict[str, dict]]:
+    with _state_lock:
+        return {
+            direction: {
+                site: {"count": c, "bytes": b} for site, (c, b) in table.items()
+            }
+            for direction, table in _transfers.items()
+        }
+
+
+def reset() -> None:
+    with _state_lock:
+        _sites.clear()
+        _transfers["h2d"].clear()
+        _transfers["d2h"].clear()
+
+
+def snapshot() -> dict:
+    """Recorder state, for tests that enable/reset without clobbering a
+    session-level sanitizer (tier-1 under ``LDT_COMPILE_SANITIZER=1``
+    collects its witness ACROSS the suite — same discipline as
+    ``leaktrack.snapshot``)."""
+    with _state_lock:
+        return {
+            "sites": {
+                site: {
+                    "calls": rec["calls"],
+                    "keys": list(rec["keys"]),
+                    "post_warmup": rec["post_warmup"],
+                }
+                for site, rec in _sites.items()
+            },
+            "transfers": {
+                direction: {site: list(v) for site, v in table.items()}
+                for direction, table in _transfers.items()
+            },
+            "enabled": _enabled,
+        }
+
+
+def restore(state: dict) -> None:
+    global _enabled
+    with _state_lock:
+        _sites.clear()
+        for site, rec in state["sites"].items():
+            _sites[site] = {
+                "calls": rec["calls"],
+                "keys": list(rec["keys"]),
+                "post_warmup": rec["post_warmup"],
+            }
+        for direction in ("h2d", "d2h"):
+            _transfers[direction].clear()
+            _transfers[direction].update(
+                {s: list(v) for s, v in state["transfers"].get(direction, {}).items()}
+            )
+    _enabled = state["enabled"]
+
+
+def dump(path: Optional[str] = None) -> str:
+    """Write the witness JSON (atomically — the CI stage feeds it straight
+    into ``ldt check --compile-witness``, and a torn file must fail loudly
+    as absent, not parse as an empty witness). Returns the path written."""
+    path = path or os.environ.get(ENV_PATH) or DEFAULT_WITNESS_PATH
+    payload = {
+        "version": 1,
+        "compiles": dict(sorted(sites().items())),
+        "transfers": {
+            direction: dict(sorted(table.items()))
+            for direction, table in transfers().items()
+        },
+    }
+    import tempfile
+
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".tmp-compilewitness-")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path

@@ -7,22 +7,78 @@ Distinguishes the two outage signatures seen in rounds 3-4:
     blocked >10 min with zero client CPU inside ``create_train_state``).
 
 Prints ONE JSON line; exits 0 only when a real value came back from the
-chip. The hang watchdog is a daemon ``threading.Timer`` + ``os._exit``
-(the ``_HangWatchdog`` pattern from ``_bench_init.py``), NOT ``signal.alarm``:
-a claim-hang blocks inside a C/gRPC call where the main thread never
-returns to the interpreter, so a Python signal handler would never run —
-only another thread can still emit the structured line and exit.
+chip.  Two layers of fail-fast, because BENCH_r03-r05 showed a wedged
+tunnel can defeat any single one:
+
+  * The backend init runs in a **timeout-bounded child subprocess**
+    (``--child``).  The parent never imports a backend, so even a child
+    stuck inside a C/gRPC call with its GIL held cannot hang the
+    campaign — the parent kills it and emits a diagnostic dump (env
+    snapshot, jax version, registered platform list, the child's last
+    reported stage) instead of silence.
+  * Inside the child, a daemon ``threading.Timer`` + ``os._exit``
+    watchdog (the ``_HangWatchdog`` pattern from ``_bench_init.py``),
+    NOT ``signal.alarm``: a claim-hang blocks where the main thread
+    never returns to the interpreter, so a Python signal handler would
+    never run — only another thread can still emit the structured line.
+    When the child manages to die on its own its line is richer (exact
+    stage timing), so the parent gives it a short grace window before
+    the hard kill.
 """
 
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
 
 TIMEOUT_S = int(os.environ.get("PROBE_TIMEOUT", "240") or 240)
+# Parent grace on top of the child's own watchdog: the child's line has
+# exact stage timing, so let it fire first when it can.
+PARENT_GRACE_S = 20
 _t0 = time.time()
 _stage = "import"
+
+_ENV_PREFIXES = ("JAX_", "TPU_", "PROBE_", "LIBTPU", "XLA_", "PJRT_")
+
+
+def _env_snapshot() -> dict:
+    return {k: v for k, v in sorted(os.environ.items())
+            if k.startswith(_ENV_PREFIXES)}
+
+
+def _diagnostics() -> dict:
+    """Actionable state for a hang report.  Must NOT claim a backend:
+    everything here is import-time metadata only."""
+    diag = {
+        "python": sys.version.split()[0],
+        "env": _env_snapshot(),
+    }
+    try:
+        import jax
+
+        diag["jax_version"] = jax.__version__
+        diag["jax_platforms_config"] = str(
+            getattr(jax.config, "jax_platforms", None))
+        try:
+            # Registered PJRT factory names — available without
+            # initializing any backend (private API, best effort).
+            from jax._src import xla_bridge
+
+            diag["registered_platforms"] = sorted(
+                getattr(xla_bridge, "_backend_factories", {}))
+        except Exception:  # noqa: BLE001 — diagnostics never raise
+            pass
+    except Exception as e:  # noqa: BLE001 — diagnostics never raise
+        diag["jax_import_error"] = f"{type(e).__name__}: {e}"
+    return diag
+
+
+def _stage_note(stage: str) -> None:
+    """Child → parent progress marker on stderr, so a hard-killed child
+    still tells the parent which stage wedged."""
+    print(f"[probe] stage={stage}", file=sys.stderr, flush=True)
 
 
 def _fire() -> None:
@@ -36,11 +92,12 @@ def _fire() -> None:
     os._exit(2)
 
 
-def main() -> int:
+def _child_main() -> int:
     global _stage
     watchdog = threading.Timer(TIMEOUT_S, _fire)
     watchdog.daemon = True
     watchdog.start()
+    _stage_note(_stage)
 
     import jax
 
@@ -56,6 +113,7 @@ def main() -> int:
             pass
 
     _stage = "claim"
+    _stage_note(_stage)
     t_claim = time.time()
     devices = jax.devices()
     claim_s = time.time() - t_claim
@@ -74,6 +132,7 @@ def main() -> int:
         return 3
 
     _stage = "execute"
+    _stage_note(_stage)
     import jax.numpy as jnp
 
     t_exec = time.time()
@@ -94,21 +153,88 @@ def main() -> int:
     return 0
 
 
-if __name__ == "__main__":
+def _last_stage_from_stderr(stderr: str) -> str:
+    stage = "import"
+    for line in (stderr or "").splitlines():
+        if line.startswith("[probe] stage="):
+            stage = line.split("=", 1)[1].strip()
+    return stage
+
+
+def main() -> int:
+    """Parent: run the claiming child under a hard timeout and guarantee
+    one parseable JSON line on stdout, whatever the child does."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--child"]
     try:
-        sys.exit(main())
-    except BaseException as e:  # noqa: BLE001 — structured line no matter what
-        # A fast-RAISING outage (e.g. connection refused from the tunnel)
-        # must still leave a parseable line: the campaign classifies an
-        # empty stdout + fast exit as a LOCAL crash, and a quick
-        # `UNAVAILABLE` from jax.devices() is an outage, not a local error.
-        if isinstance(e, SystemExit):
-            raise
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True,
+            timeout=TIMEOUT_S + PARENT_GRACE_S,
+        )
+    except subprocess.TimeoutExpired as e:
+        stderr = e.stderr
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode("utf-8", "replace")
         print(json.dumps({
             "probe": "tpu_liveness",
             "ok": False,
-            "stage": _stage,
+            "stage": _last_stage_from_stderr(stderr or ""),
             "elapsed_s": round(time.time() - _t0, 1),
-            "error": f"exception: {type(e).__name__}: {e}",
+            "error": f"hang: child exceeded {TIMEOUT_S + PARENT_GRACE_S}s "
+                     "and was killed by the parent (its in-process "
+                     "watchdog never fired)",
+            "diagnostics": _diagnostics(),
         }), flush=True)
-        sys.exit(5)
+        return 2
+
+    # Forward the child's stage markers for the campaign error log.
+    if proc.stderr:
+        sys.stderr.write(proc.stderr)
+        sys.stderr.flush()
+
+    line = ""
+    for candidate in (proc.stdout or "").splitlines():
+        if candidate.strip():
+            line = candidate.strip()
+    try:
+        payload = json.loads(line)
+    except (ValueError, TypeError):
+        payload = {
+            "probe": "tpu_liveness",
+            "ok": False,
+            "stage": _last_stage_from_stderr(proc.stderr or ""),
+            "elapsed_s": round(time.time() - _t0, 1),
+            "error": f"child exited {proc.returncode} without a "
+                     "parseable JSON line",
+            "stdout_tail": (proc.stdout or "")[-500:],
+            "stderr_tail": (proc.stderr or "")[-500:],
+        }
+    if not payload.get("ok"):
+        payload.setdefault("diagnostics", _diagnostics())
+    print(json.dumps(payload), flush=True)
+    if payload.get("ok"):
+        return 0
+    return proc.returncode if proc.returncode not in (0, None) else 5
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv[1:]:
+        try:
+            sys.exit(_child_main())
+        except BaseException as e:  # noqa: BLE001 — structured line always
+            # A fast-RAISING outage (e.g. connection refused from the
+            # tunnel) must still leave a parseable line: the campaign
+            # classifies an empty stdout + fast exit as a LOCAL crash, and
+            # a quick `UNAVAILABLE` from jax.devices() is an outage, not a
+            # local error.
+            if isinstance(e, SystemExit):
+                raise
+            print(json.dumps({
+                "probe": "tpu_liveness",
+                "ok": False,
+                "stage": _stage,
+                "elapsed_s": round(time.time() - _t0, 1),
+                "error": f"exception: {type(e).__name__}: {e}",
+            }), flush=True)
+            sys.exit(5)
+    else:
+        sys.exit(main())

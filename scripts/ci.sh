@@ -55,13 +55,15 @@
 # must reproduce every blob exactly (constructor/framing drift fails the
 # gate; `ldt protocol goldens --update` regenerates a reviewable diff).
 # Stage 8 — the tier-1 verify command from ROADMAP.md, verbatim — run
-# under LDT_LOCK_SANITIZER=1, LDT_LEAK_SANITIZER=1 AND
-# LDT_WIRE_SANITIZER=1: every threading.Lock/RLock the package creates is
-# wrapped to record actual acquisition orderings, every BufferPool page
-# lease/release and shm slot token handoff is recorded against its
-# acquire site, every control frame's (msg, field) tuples are counted as
-# they cross the loopback wire, and conftest dumps all three witness
-# JSONs on exit.
+# under LDT_LOCK_SANITIZER=1, LDT_LEAK_SANITIZER=1, LDT_WIRE_SANITIZER=1
+# AND LDT_COMPILE_SANITIZER=1: every threading.Lock/RLock the package
+# creates is wrapped to record actual acquisition orderings, every
+# BufferPool page lease/release and shm slot token handoff is recorded
+# against its acquire site, every control frame's (msg, field) tuples
+# are counted as they cross the loopback wire, every jit funnel's
+# dispatches/abstract signatures/post-warmup retraces and H2D/D2H
+# transfers are recorded per def site, and conftest dumps all four
+# witness JSONs on exit.
 # Stage 9 — `ldt check --lock-witness` against the lock witness: the
 # runtime evidence corroborates (or prunes) the static LDT1001 lock-order
 # cycles, and any NEW LDT10xx finding fails the build exactly like stage 1.
@@ -76,6 +78,13 @@
 # orphan-read findings, with the same >= 1 matched-tuple receipt — a
 # zero-overlap witness means the protocol hooks or the schema model
 # silently rotted.
+# Stage 12 — `ldt check --compile-witness` against the compile witness:
+# runtime compile/transfer evidence corroborates (or prunes) the static
+# LDT1703 recompile hazards, with the same >= 1 matched-site receipt.
+# Stage 13 — steady-state recompile gate: a short real `train` run under
+# the compile sanitizer must record ZERO post-warmup retraces across
+# every jit site — the paper's fixed-shape contract (one trace per
+# kernel, then pure dispatch), re-proven per commit.
 set -e
 cd "$(dirname "$0")/.."
 
@@ -199,12 +208,13 @@ echo "== protocol goldens (cross-version byte-identity gate) =="
 # the reviewable escape hatch).
 timeout -k 10 120 env JAX_PLATFORMS=cpu PYTHONPATH=. python -m lance_distributed_training_tpu.cli protocol goldens
 
-echo "== tier-1 tests (lock + leak + wire sanitizers on) =="
+echo "== tier-1 tests (lock + leak + wire + compile sanitizers on) =="
 WITNESS=/tmp/_ldt_lock_witness.json
 LEAK_WITNESS=/tmp/_ldt_leak_witness.json
 WIRE_WITNESS=/tmp/_ldt_wire_witness.json
-rm -f "$WITNESS" "$LEAK_WITNESS" "$WIRE_WITNESS"
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu LDT_LOCK_SANITIZER=1 LDT_LOCK_WITNESS_PATH="$WITNESS" LDT_LEAK_SANITIZER=1 LDT_LEAK_WITNESS_PATH="$LEAK_WITNESS" LDT_WIRE_SANITIZER=1 LDT_WIRE_WITNESS_PATH="$WIRE_WITNESS" python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+COMPILE_WITNESS=/tmp/_ldt_compile_witness.json
+rm -f "$WITNESS" "$LEAK_WITNESS" "$WIRE_WITNESS" "$COMPILE_WITNESS"
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu LDT_LOCK_SANITIZER=1 LDT_LOCK_WITNESS_PATH="$WITNESS" LDT_LEAK_SANITIZER=1 LDT_LEAK_WITNESS_PATH="$LEAK_WITNESS" LDT_WIRE_SANITIZER=1 LDT_WIRE_WITNESS_PATH="$WIRE_WITNESS" LDT_COMPILE_SANITIZER=1 LDT_COMPILE_WITNESS_PATH="$COMPILE_WITNESS" python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
 echo "== lock-order witness cross-check =="
@@ -233,3 +243,47 @@ test -s "$WIRE_WITNESS" || { echo "missing wire witness $WIRE_WITNESS"; exit 1; 
 python scripts/ldt_check.py --wire-witness "$WIRE_WITNESS" | tee /tmp/_wirecheck.log
 grep -E 'wire witness: [1-9][0-9]*/[0-9]+ observed \(msg, field\) tuples match' /tmp/_wirecheck.log \
   || { echo "wire witness corroborated no static schema field"; exit 1; }
+
+echo "== compile/transfer witness cross-check =="
+# The instrumented run's per-jit-site compile and H2D/D2H evidence, fed
+# back into the LDT1703 gate — and an assertion that the witness actually
+# overlaps the static mesh model: at least one runtime jit site must
+# match a static jit def site, or the def-site join key silently rotted.
+test -s "$COMPILE_WITNESS" || { echo "missing compile witness $COMPILE_WITNESS"; exit 1; }
+python scripts/ldt_check.py --compile-witness "$COMPILE_WITNESS" | tee /tmp/_compilecheck.log
+grep -E 'compile witness: [1-9][0-9]*/[0-9]+ runtime jit sites match' /tmp/_compilecheck.log \
+  || { echo "compile witness corroborated no static jit site"; exit 1; }
+
+echo "== steady-state recompile gate (short train smoke) =="
+# A real multi-step train run: after the first dispatch per jit site
+# (warmup trace) every later call must reuse a seen abstract signature.
+# Any post-warmup retrace — a per-batch shape, a drifting static — fails.
+timeout -k 10 300 env JAX_PLATFORMS=cpu LDT_COMPILE_SANITIZER=1 PYTHONPATH=. python - <<'PY'
+import json
+import numpy as np
+
+from lance_distributed_training_tpu.data import create_text_token_dataset
+from lance_distributed_training_tpu.trainer import TrainConfig, train
+from lance_distributed_training_tpu.utils import compiletrack
+
+import pathlib, tempfile
+tmp = pathlib.Path(tempfile.mkdtemp(prefix="ldt-ci-compile-"))
+gen = np.random.default_rng(0)
+docs = [gen.integers(2, 512, gen.integers(10, 60)).tolist() for _ in range(200)]
+uri = str(tmp / "tokens")
+create_text_token_dataset(uri, docs, seq_len=32, fragment_size=32)
+results = train(TrainConfig(
+    dataset_path=uri, task_type="masked_lm", model_name="bert_small",
+    batch_size=16, epochs=2, seq_len=32, vocab_size=512, no_wandb=True,
+    eval_at_end=True,
+))
+assert np.isfinite(results["loss"])
+sites = compiletrack.sites()
+assert sites, "compile sanitizer recorded no jit sites during train"
+recompiled = {s: e for s, e in sites.items() if e["post_warmup"] > 0}
+assert not recompiled, f"post-warmup recompiles in steady state: {recompiled}"
+exercised = sum(1 for e in sites.values() if e["calls"] > 1)
+print(f"recompile gate ok: {len(sites)} jit sites, {exercised} exercised "
+      f"past warmup, 0 post-warmup retraces "
+      f"(h2d events: {sum(v['count'] for v in compiletrack.transfers()['h2d'].values())})")
+PY

@@ -77,6 +77,14 @@ def pytest_unconfigure(config):
         leaktrack = _load_util("leaktrack")
         path = leaktrack.dump()
         sys.stderr.write(f"\n[leaktrack] witness written to {path}\n")
+    if os.environ.get("LDT_COMPILE_SANITIZER") == "1":
+        # Compile/transfer witness (LDT1703's evidence half): the package's
+        # jit funnels counted per-def-site trace signatures and the
+        # placement door counted H2D/D2H events across the suite — dump for
+        # `ldt check --compile-witness`.
+        compiletrack = _load_util("compiletrack")
+        path = compiletrack.dump()
+        sys.stderr.write(f"\n[compiletrack] witness written to {path}\n")
     if os.environ.get("LDT_WIRE_SANITIZER") == "1":
         # Wire-traffic witness (LDT1403's evidence half): the protocol
         # hooks counted every (msg, field) tuple that crossed the

@@ -1,0 +1,20 @@
+"""Axis-vocabulary fixtures: one clean spec, two typo'd references."""
+
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def good_spec():
+    return P("data", None)
+
+
+def bad_spec():
+    return P("dta", None)  # planted LDT1701: typo'd PartitionSpec axis
+
+
+def good_collective(x):
+    return lax.pmean(x, "model")
+
+
+def bad_collective(x):
+    return lax.psum(x, "modle")  # planted LDT1701: typo'd collective axis

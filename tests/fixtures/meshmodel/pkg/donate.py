@@ -1,0 +1,24 @@
+"""Donation fixtures: a factory-made donating step, one caller that reads
+the donated state again, one that rebinds it."""
+
+import jax
+
+
+def step(state, batch):
+    return state + batch
+
+
+def make_step():
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def run_hazard(state, batch):
+    step_fn = make_step()
+    out = step_fn(state, batch)
+    return state + out  # planted LDT1702: state was donated one line up
+
+
+def run_clean(state, batch):
+    step_fn = make_step()
+    state = step_fn(state, batch)  # rebind: the donated buffer is dead
+    return state

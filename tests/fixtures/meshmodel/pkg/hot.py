@@ -1,0 +1,14 @@
+"""Host-sync fixtures: one stray drain on the declared hot path, one
+inside the declared sync funnel."""
+
+import jax.numpy as jnp
+
+
+def hazard(x):
+    val = jnp.sum(x)
+    return float(val)  # planted LDT1704: stray host sync on a hot path
+
+
+def drain_ok(x):
+    val = jnp.sum(x)
+    return float(val)  # clean: drain_ok is a declared sync funnel

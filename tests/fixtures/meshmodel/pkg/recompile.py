@@ -1,0 +1,32 @@
+"""Recompile-hazard fixtures: a shape-derived static argument (raw and
+funneled) and a Python shape branch inside a jitted content function."""
+
+from functools import partial
+
+import jax
+
+
+def quantize_rows(n):
+    return ((n + 7) // 8) * 8
+
+
+@partial(jax.jit, static_argnames=("rows",))
+def kernel(x, *, rows):
+    return x[:rows]
+
+
+def call_hazard(batch):
+    rows = batch.shape[0]
+    return kernel(batch, rows=rows)  # planted LDT1703: per-batch static
+
+
+def call_funneled(batch):
+    rows = quantize_rows(batch.shape[0])
+    return kernel(batch, rows=rows)  # clean: quantized through the funnel
+
+
+@jax.jit
+def jit_branch(x):
+    if x.shape[0] > 4:  # planted LDT1703: Python branch on param shape
+        return x * 2.0
+    return x

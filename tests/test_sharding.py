@@ -191,3 +191,115 @@ def test_train_entrypoint_with_model_parallelism(tmp_path):
     results = train(cfg)
     assert np.isfinite(results["loss"])
     assert 0.0 <= results["train_acc"] <= 1.0
+
+
+# --------------------------------------------- mesh-axis vocabulary pins
+def _spec_axis_names(tree):
+    """Every mesh-axis name appearing anywhere in a spec/sharding tree."""
+    from jax.sharding import NamedSharding
+
+    names = set()
+    leaves = jax.tree_util.tree_leaves(
+        tree, is_leaf=lambda x: isinstance(x, (P, NamedSharding))
+    )
+    for leaf in leaves:
+        spec = leaf.spec if hasattr(leaf, "spec") else leaf
+        for part in spec:
+            if part is None:
+                continue
+            if isinstance(part, (tuple, list)):
+                names.update(part)
+            else:
+                names.add(part)
+    return names
+
+
+def test_strategy_axes_match_declared_mesh_vocabulary():
+    """Every axis name any sharding strategy can emit — {fsdp, zero1,
+    zero2, seq, pipeline}, all under grad accumulation — must be in the
+    `[tool.ldt-check] mesh-axes` vocabulary, the same list LDT1701 checks
+    PartitionSpec/collective literals against. A strategy minting an axis
+    outside it would silently replicate in prod AND dodge the linter."""
+    pytest.importorskip("tomli")
+    import os
+
+    from lance_distributed_training_tpu.analysis.config import load_config
+    from lance_distributed_training_tpu.trainer import create_train_state
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    vocab = set(load_config(root).mesh_axes)
+    assert vocab == {"data", "model", "seq", "pipe"}
+
+    task = _bert_task()
+    cfg = TrainConfig(dataset_path="", lr=0.1, momentum=0.9, grad_accum=2)
+    abstract = jax.eval_shape(
+        lambda r: create_train_state(r, task, cfg), jax.random.key(0)
+    )
+    mesh = get_mesh(model_parallelism=2)
+
+    strategies = {
+        "rules": dict(),
+        "fsdp": dict(fsdp_axis="data"),
+        "zero1": dict(zero_axis="data", zero_level=1),
+        "zero2": dict(zero_axis="data", zero_level=2),
+    }
+    for name, kwargs in strategies.items():
+        shardings = state_shardings(abstract, mesh, TRANSFORMER_RULES,
+                                    **kwargs)
+        axes = _spec_axis_names(shardings)
+        assert axes <= vocab, (name, axes - vocab)
+        assert "model" in axes, name  # rule-sharded params everywhere
+    # Sequence parallelism: the token-batch spec uses declared axes only.
+    assert _spec_axis_names([batch_partition_spec(2, seq_axis="seq")]) == \
+        {"data", "seq"}
+    # Pipeline parallelism: the stage-stacked param layout and the mesh
+    # axis it runs over are both in the vocabulary.
+    from lance_distributed_training_tpu.parallel.pipeline_parallel import (
+        pipeline_apply,
+    )
+
+    import inspect
+
+    pipe_axis = inspect.signature(pipeline_apply).parameters["pipe_axis"]
+    assert pipe_axis.default in vocab
+    assert _spec_axis_names([P(pipe_axis.default)]) == {"pipe"}
+    full = get_mesh(model_parallelism=2, seq_parallelism=2,
+                    pipe_parallelism=2)
+    assert set(full.axis_names) <= vocab
+
+
+def test_zero_levels_shard_moments_and_accumulator_as_documented():
+    """ZeRO-1 shards the optimizer moments but leaves the grad-accumulation
+    buffer replicated; ZeRO-2 shards both; neither touches the params.
+    All over the 'data' axis — pinned by name, per leaf path."""
+    from lance_distributed_training_tpu.trainer import create_train_state
+
+    task = _bert_task()
+    cfg = TrainConfig(dataset_path="", lr=0.1, momentum=0.9, grad_accum=2)
+    abstract = jax.eval_shape(
+        lambda r: create_train_state(r, task, cfg), jax.random.key(0)
+    )
+    mesh = get_mesh()  # DP-only: 'data' is the only axis in play
+
+    def _probe(shardings):
+        # A large momentum leaf, the matching acc_grads leaf, its param.
+        trace = shardings.opt_state.inner_opt_state[0].trace
+        return (
+            shardings.params["layer_0"]["mlp_in"]["kernel"].spec,
+            trace["layer_0"]["mlp_in"]["kernel"].spec,
+            shardings.opt_state.acc_grads["layer_0"]["mlp_in"]["kernel"].spec,
+        )
+
+    z1 = state_shardings(abstract, mesh, (), zero_axis="data", zero_level=1)
+    param, moment, acc = _probe(z1)
+    assert param == P()
+    assert moment == P("data") or "data" in _spec_axis_names([moment])
+    assert acc == P()
+    z2 = state_shardings(abstract, mesh, (), zero_axis="data", zero_level=2)
+    param, moment, acc = _probe(z2)
+    assert param == P()
+    assert "data" in _spec_axis_names([moment])
+    assert "data" in _spec_axis_names([acc])
+    # Small leaves (biases, step counters) stay replicated at every level.
+    assert z2.params["layer_0"]["mlp_in"]["bias"].spec == P()
+    assert z2.opt_state.mini_step.spec == P()
