@@ -5,7 +5,7 @@ Flag-for-flag parity with ``/root/reference/lance_iterable.py:136-146`` (plus
 ``lance_map_style.py:128-148``, and TPU knobs). Topology comes from JAX
 process discovery, not torchrun env vars (``lance_iterable.py:154-156``).
 
-Six subcommands share the ``ldt`` entry point:
+The subcommands share the ``ldt`` entry point:
 
 * ``ldt train …`` (or bare flags, backward-compatible) — the trainer;
 * ``ldt serve-data …`` — the disaggregated input-data service: decode on
@@ -18,8 +18,13 @@ Six subcommands share the ``ldt`` entry point:
   non-zero on new findings; see README "Static analysis");
 * ``ldt graph …`` — the cross-module concurrency model (spawned threads,
   locks, lock-order edges) as Graphviz DOT or a text summary;
-* ``ldt trace export …`` — convert recorded span JSONL (LDT_TRACE_PATH)
-  into a Perfetto-loadable Chrome trace (see README "Telemetry").
+* ``ldt trace export …`` — merge recorded span JSONLs (LDT_TRACE_PATH,
+  one per process) into a Perfetto-loadable Chrome trace with
+  cross-process flow arrows (see README "Causal tracing & SLOs");
+* ``ldt trace critical-path …`` — per-batch dominant-segment attribution
+  (decode/cache/queue-wait/wire/h2d/step) + straggler table;
+* ``ldt costs report …`` — the per-item cost ledger (LDT_COST_PATH):
+  totals and the slowest items by decode cost.
 
 Usage::
 
@@ -496,6 +501,18 @@ def fleet_main(argv=None) -> int:
                 f"clients {pressure.get('active_clients', '-')} "
                 f"(heartbeat {m.get('heartbeat_age_s')}s ago)"
             )
+        queue_wait = payload.get("queue_wait_ms")
+        if isinstance(queue_wait, dict):
+            # Fleet-wide percentiles merged from the members' heartbeat
+            # histograms (protocol v5) — exact, not a mean of p99s.
+            print(
+                "fleet queue_wait: "
+                f"p50 {queue_wait.get('p50_ms')}ms "
+                f"p95 {queue_wait.get('p95_ms')}ms "
+                f"p99 {queue_wait.get('p99_ms')}ms "
+                f"({queue_wait.get('count')} waits, "
+                f"{queue_wait.get('members')} members reporting)"
+            )
         print(
             f"recommendation: {recommendation.get('action')} — "
             f"{recommendation.get('reason', '')}"
@@ -613,10 +630,20 @@ def main(argv=None) -> dict:
         return graph_main(argv[1:])
     if argv and argv[0] == "trace":
         # Telemetry export: span JSONL (LDT_TRACE_PATH) → Chrome-trace JSON
-        # loadable in Perfetto. Returns an int exit status.
+        # loadable in Perfetto (`ldt trace export`, multi-process merge with
+        # flow arrows) and per-batch critical-path attribution with a
+        # straggler table (`ldt trace critical-path`). Returns an int exit
+        # status.
         from .obs.spans import trace_main
 
         return trace_main(argv[1:])
+    if argv and argv[0] == "costs":
+        # Per-item cost ledger report: decode cost JSONL (LDT_COST_PATH) →
+        # totals + slowest-items table (`ldt costs report`). Returns an int
+        # exit status.
+        from .obs.costs import costs_main
+
+        return costs_main(argv[1:])
     if argv and argv[0] == "train":
         argv = argv[1:]
     args = build_parser().parse_args(argv)

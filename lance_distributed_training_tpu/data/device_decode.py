@@ -46,6 +46,7 @@ from typing import Optional, Union
 import numpy as np
 import pyarrow as pa
 
+from ..obs.costs import note_cost
 from ..obs.registry import default_registry
 
 __all__ = ["CoeffImageDecoder", "coeff_decoder_or_fallback"]
@@ -302,9 +303,18 @@ class CoeffImageDecoder:
         CPU) and the coefficient-byte counter the wire-traffic trade is
         judged by."""
         t0 = time.monotonic_ns()
+        reenc_before = self._reencodes.value
         batch = self._extract(pointers, source)
-        self._entropy_ms.observe((time.monotonic_ns() - t0) / 1e6)
+        entropy_ms = (time.monotonic_ns() - t0) / 1e6
+        self._entropy_ms.observe(entropy_ms)
         self._coeff_bytes.inc(sum(v.nbytes for v in batch.values()))
+        # Cost-ledger hand-off: lands on the enclosing cost_context (the
+        # server's per-item decode scope) when one is open on this thread;
+        # a free-standing decode (tests, worker subprocess) drops it.
+        note_cost(
+            entropy_ms=round(entropy_ms, 3),
+            reencode=self._reencodes.value > reenc_before,
+        )
         return batch
 
     def decode_payloads(self, payloads: list[bytes]) -> dict[str, np.ndarray]:

@@ -60,10 +60,12 @@ from typing import Callable, Iterator, Optional, Sequence
 import numpy as np
 import pyarrow as pa
 
+from ..obs.costs import cost_context
 from ..obs.lineage import make_lineage, observe_local_lineage
 from ..obs.registry import default_registry
 from ..obs.spans import span
 from ..tune.tunable import AdjustableQueue, Tunable, _LiveQueues
+from .cache import item_fingerprint
 from .format import Dataset
 from .samplers import (
     ReadRange,
@@ -312,9 +314,22 @@ class DataPipeline:
                     if stop.is_set():
                         return
                     t0 = time.monotonic_ns()
-                    with span("pipeline.decode", batch_seq=seq):
+                    # In-process decode runs on THIS thread, so the cost
+                    # scope catches the decoder's note_cost() calls
+                    # (entropy_ms, token_len) — the local-loader twin of
+                    # the server's per-item ledger record.
+                    with cost_context(item_fingerprint(item),
+                                      step=seq) as cost, \
+                         span("pipeline.decode", batch_seq=seq):
                         out = self._decode_item(item)
-                    decode_ms = (time.monotonic_ns() - t0) / 1e6
+                        decode_ms = (time.monotonic_ns() - t0) / 1e6
+                        cost.note(
+                            decode_ms=round(decode_ms, 3),
+                            bytes=sum(
+                                getattr(v, "nbytes", 0)
+                                for v in out.values()
+                            ),
+                        )
                     q.put((make_lineage(seq, decode_ms), out))
             q.put(_SENTINEL)
         except BaseException as exc:  # surface worker errors to the consumer

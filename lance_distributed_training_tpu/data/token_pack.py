@@ -47,6 +47,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import pyarrow as pa
 
+from ..obs.costs import note_cost
+
 __all__ = [
     "VALUES_SUFFIX",
     "OFFSETS_SUFFIX",
@@ -512,6 +514,14 @@ class TokenDecoder:
                     col.chunk(0) if isinstance(col, pa.ChunkedArray) else col
                 )
                 fixed[name] = values
+        if ragged:
+            # Cost-ledger hand-off (see device_decode._observed): total
+            # real token payload of this batch, attributed to the open
+            # per-item cost scope when the server is decoding it.
+            note_cost(token_len=sum(
+                int(offsets[-1]) if len(offsets) else 0
+                for _, offsets in ragged.values()
+            ))
         if not ragged:
             # Fixed-shape dataset: nothing to pack/pad; still account the
             # grid so pad_waste_pct reads honestly (mask-weighted when the

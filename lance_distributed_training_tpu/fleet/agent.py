@@ -48,6 +48,11 @@ class FleetAgent:
         # stall/occupancy this member reports per heartbeat (the
         # coordinator's scale-recommendation input; None = no pressure
         # field, pre-r9 heartbeat shape)
+        hist_fn: Optional[Callable[[], Optional[dict]]] = None,  # v5:
+        # mergeable queue-wait histogram ({counts, sum, count}) per
+        # heartbeat — the coordinator sums bucket counts across members
+        # into fleet-wide percentiles. None (or a None return) omits the
+        # field, so pre-v5 coordinators see the exact old payload.
     ):
         self.coordinator_host, self.coordinator_port = P.parse_hostport(
             coordinator_addr
@@ -60,6 +65,7 @@ class FleetAgent:
         self.on_lease_change = on_lease_change
         self.counters = counters
         self.pressure_fn = pressure_fn
+        self.hist_fn = hist_fn
         self.heartbeat_interval_s = heartbeat_interval_s
         self.dial_timeout_s = dial_timeout_s
         self.backoff_s = backoff_s
@@ -138,6 +144,13 @@ class FleetAgent:
                 payload["pressure"] = self.pressure_fn()
             except Exception:  # noqa: BLE001 — telemetry must never kill
                 pass  # the heartbeat that keeps the lease alive
+        if self.hist_fn is not None:
+            try:
+                hist = self.hist_fn()
+                if hist is not None:
+                    payload["queue_wait_hist"] = hist
+            except Exception:  # noqa: BLE001 — same contract as pressure
+                pass
         try:
             msg_type, reply = self._call(P.MSG_FLEET_HEARTBEAT, payload)
         except (ConnectionError, OSError, P.ProtocolError):

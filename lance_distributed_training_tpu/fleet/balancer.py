@@ -44,6 +44,8 @@ from typing import Callable, Iterator, Optional, Sequence
 
 from ..obs.lineage import observe_wire_lineage
 from ..obs.registry import MetricsRegistry, default_registry
+from ..obs.spans import span
+from ..obs.tracectx import child, coerce_trace
 from ..tune.tunable import AdjustableQueue, Tunable, _LiveQueues
 from ..utils.metrics import ServiceCounters
 from ..utils.retry import RetryPolicy, retrying
@@ -188,10 +190,24 @@ class _StripeRound:
                     return
                 if msg_type == P.MSG_BATCH:
                     recv_ns = time.time_ns()
-                    step, batch, lineage = P.decode_batch(
-                        payload["raw"], with_lineage=True,
-                        pool=loader.buffer_pool,
-                    )
+                    with span("fleet.recv", step=expected,
+                              stripe=i) as sp_attrs:
+                        step, batch, lineage, trace = P.decode_batch(
+                            payload["raw"], with_lineage=True,
+                            with_trace=True, pool=loader.buffer_pool,
+                        )
+                        # Continue the member's causal chain (v5) — same
+                        # child-hop stamping as RemoteLoader, so a merged
+                        # export draws the member→merge parent edge.
+                        trace = coerce_trace(trace)
+                        if trace is not None:
+                            hop = child(trace)
+                            sp_attrs.update(
+                                trace_id=hop["trace_id"],
+                                trace_parent=hop["parent_span_id"],
+                                trace_span=hop["span_id"],
+                            )
+                            loader.last_trace = hop
                     if step != expected:
                         raise P.ProtocolError(
                             f"stripe {i}/{self.count}: out-of-order step "
@@ -387,6 +403,8 @@ class FleetLoader:
         self.exclusion_ttl_s = exclusion_ttl_s
         self.recent_lineage: deque = deque(maxlen=1024)
         self.last_lineage: Optional[dict] = None
+        # Last batch's continued trace context (v5), as in RemoteLoader.
+        self.last_trace: Optional[dict] = None
         self.client_id = uuid.uuid4().hex
         self.generation: int = 0  # last resolved lease generation
         self._num_steps: Optional[int] = None

@@ -54,7 +54,13 @@ import threading
 import time
 from typing import Optional
 
-from ..obs.registry import MetricsRegistry, default_registry
+from ..obs.registry import (
+    DEFAULT_MS_BUCKETS,
+    MetricsRegistry,
+    default_registry,
+    percentile_from_counts,
+)
+from ..obs.spans import span
 from ..service import protocol as P
 
 __all__ = ["CoordinatorConfig", "Coordinator", "serve_coordinator",
@@ -96,7 +102,7 @@ class _Member:
 
     __slots__ = ("server_id", "addr", "num_fragments", "last_heartbeat",
                  "stripe_index", "fragment_lo", "fragment_hi", "pressure",
-                 "acked_generation")
+                 "acked_generation", "queue_wait_hist")
 
     def __init__(self, server_id: str, addr: str, num_fragments: int):
         self.server_id = server_id
@@ -114,6 +120,10 @@ class _Member:
         # "active_clients": …}; None until a pressure-carrying heartbeat —
         # pre-r9 members never send one and simply stay None).
         self.pressure: Optional[dict] = None
+        # Latest mergeable queue-wait histogram ({"counts": [...], "sum",
+        # "count"}, protocol v5) — None for pre-v5 members, exactly like
+        # pressure. Bucket bounds are DEFAULT_MS_BUCKETS on both sides.
+        self.queue_wait_hist: Optional[dict] = None
 
     def lease(self, generation: int, stripe_count: int) -> dict:
         return {
@@ -171,6 +181,46 @@ class Coordinator:
             (time.perf_counter() - t0) * 1e3
         )
 
+    def _queue_wait_merged_locked(self) -> Optional[dict]:
+        """Fleet-wide queue-wait percentiles from the members' v5
+        heartbeat histograms (caller holds ``_lock``): sum the bucket
+        count vectors — histograms with shared bounds merge exactly, the
+        property per-member p99 gauges don't have — then interpolate.
+        None before any well-formed report."""
+        n = len(DEFAULT_MS_BUCKETS) + 1  # finite bounds + the +Inf slot
+        summed = [0] * n
+        members = total = 0
+        for m in self._members.values():
+            h = m.queue_wait_hist
+            if not isinstance(h, dict):
+                continue
+            counts = h.get("counts")
+            # Shape guard: a foreign/els future member whose bucket layout
+            # differs cannot be merged — skip it rather than corrupt the
+            # fleet aggregate.
+            if not isinstance(counts, list) or len(counts) != n:
+                continue
+            try:
+                counts = [int(c) for c in counts]
+            except (TypeError, ValueError):
+                continue
+            members += 1
+            total += sum(counts)
+            for j, c in enumerate(counts):
+                summed[j] += c
+        if not members or total <= 0:
+            return None
+        return {
+            "members": members,
+            "count": total,
+            "p50_ms": round(percentile_from_counts(
+                DEFAULT_MS_BUCKETS, summed, total, 50), 3),
+            "p95_ms": round(percentile_from_counts(
+                DEFAULT_MS_BUCKETS, summed, total, 95), 3),
+            "p99_ms": round(percentile_from_counts(
+                DEFAULT_MS_BUCKETS, summed, total, 99), 3),
+        }
+
     def _members_payload_locked(self) -> dict:
         now = time.monotonic()
         members = sorted(self._members.values(), key=lambda m: m.server_id)
@@ -190,6 +240,7 @@ class Coordinator:
                 }
                 for m in members
             ],
+            "queue_wait_ms": self._queue_wait_merged_locked(),
             "recommendation": self._recommend_locked(),
         }
 
@@ -317,12 +368,19 @@ class Coordinator:
             pressure = req.get("pressure")
             if isinstance(pressure, dict):
                 member.pressure = dict(pressure)
+            hist = req.get("queue_wait_hist")
+            if isinstance(hist, dict):
+                # Stored as-reported; shape-validated at merge time so one
+                # malformed member degrades to "not reporting", never to a
+                # poisoned aggregate.
+                member.queue_wait_hist = dict(hist)
             recommendation = self._recommend_locked()
             stalls = [
                 float(m.pressure.get("stall_pct", 0.0))
                 for m in self._members.values()
                 if isinstance(m.pressure, dict)
             ]
+            queue_wait = self._queue_wait_merged_locked()
             reply = {
                 "generation": self.generation,
                 "lease": member.lease(self.generation, len(self._members)),
@@ -338,6 +396,14 @@ class Coordinator:
             self.registry.gauge("fleet_pressure_stall_pct_mean").set(
                 sum(stalls) / len(stalls)
             )
+        if queue_wait is not None:
+            # Fleet SLO surface (v5): exact cross-member percentiles from
+            # summed bucket counts — same outside-the-lock discipline as
+            # the pressure gauges above.
+            for q in (50, 95, 99):
+                self.registry.gauge(f"fleet_queue_wait_p{q}_ms").set(
+                    queue_wait[f"p{q}_ms"]
+                )
         self.registry.gauge("fleet_scale_recommendation").set(
             recommendation.get("code", 0)
         )
@@ -441,6 +507,9 @@ class Coordinator:
         payload["status"] = "degraded" if stopped else "ok"
         payload["lease_ttl_s"] = self.config.lease_ttl_s
         payload["heartbeat_interval_s"] = self.config.heartbeat_interval_s
+        from ..obs.http import build_info
+
+        payload["build"] = build_info()
         return payload
 
     def _accept_loop(self) -> None:
@@ -475,7 +544,11 @@ class Coordinator:
                 }
             else:
                 try:
-                    reply_type, reply = handler(req)
+                    # Spanned so a coordinator run with LDT_TRACE_PATH set
+                    # appears on the merged fleet timeline (the control
+                    # plane's track next to the data-plane flows).
+                    with span("coord.handle", msg_type=msg_type, peer=peer):
+                        reply_type, reply = handler(req)
                 except (ValueError, TypeError, KeyError) as exc:
                     reply_type, reply = P.MSG_ERROR, {"message": repr(exc)}
             P.send_msg(conn, reply_type, reply)

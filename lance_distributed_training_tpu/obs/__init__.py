@@ -81,9 +81,30 @@ Protocol series (r14 — README "Protocol"):
 * the opt-in wire witness (``LDT_WIRE_SANITIZER=1``,
   ``utils/wiretrack.py``) records off-registry — per-(msg, field) wire
   counts feed ``ldt check --wire-witness``, not ``/metrics``.
+
+Causal-tracing & SLO series (r18 — README "Causal tracing & SLOs"):
+
+* :mod:`.tracectx` — W3C-style ``(trace_id, parent_span_id)`` context
+  stamped at plan-item decode, riding the protocol-v5 batch meta so one
+  batch's decode → send → merge → step chain reconstructs across
+  processes (``ldt trace export`` draws the parent edges);
+* :mod:`.costs` — per-item cost ledger (ring-buffered; ``LDT_COST_PATH``
+  JSONL; ``ldt costs report``) keyed by the BatchCache content hash:
+  ``cost_records_total`` / ``cost_bytes_total`` / ``cost_reencode_total``
+  counters plus ``cost_decode_ms`` / ``cost_entropy_ms`` /
+  ``cost_token_len`` histograms;
+* :mod:`.critpath` — per-batch dominant-segment attribution + straggler
+  table (``ldt trace critical-path``; per-epoch summary in the trainer's
+  ``critpath_*`` metrics);
+* :mod:`.slo` — declared SLOs (``LDT_SLOS``) with multi-window burn-rate
+  gauges: ``slo_<name>`` + ``slo_<name>_burn_<window>`` on ``/metrics``,
+  ``slo`` block on ``/healthz``; the fleet half aggregates member
+  heartbeat histograms into ``fleet_queue_wait_p50/p95/p99_ms``;
+* ``spans_dropped_total`` — counter: spans evicted from a full tracer
+  ring (the export prints the merged dropped count).
 """
 
-from .http import MetricsHTTPServer  # noqa: F401
+from .http import MetricsHTTPServer, build_info  # noqa: F401
 from .lineage import (  # noqa: F401
     make_lineage,
     observe_local_lineage,
@@ -100,12 +121,27 @@ from .registry import (  # noqa: F401
     percentile_from_counts,
     render_prometheus,
 )
+from .costs import (  # noqa: F401
+    CostLedger,
+    cost_context,
+    default_ledger,
+    note_cost,
+)
+from .critpath import analyze as critpath_analyze  # noqa: F401
+from .slo import SLO, DEFAULT_SLOS, SLOTracker, parse_slos  # noqa: F401
 from .spans import (  # noqa: F401
     Span,
     SpanTracer,
     chrome_trace,
     default_tracer,
     span,
+)
+from .tracectx import (  # noqa: F401
+    child,
+    coerce_trace,
+    make_trace,
+    new_span_id,
+    new_trace_id,
 )
 
 __all__ = [
@@ -127,4 +163,19 @@ __all__ = [
     "make_lineage",
     "observe_wire_lineage",
     "observe_local_lineage",
+    "build_info",
+    "CostLedger",
+    "cost_context",
+    "default_ledger",
+    "note_cost",
+    "critpath_analyze",
+    "SLO",
+    "DEFAULT_SLOS",
+    "SLOTracker",
+    "parse_slos",
+    "child",
+    "coerce_trace",
+    "make_trace",
+    "new_span_id",
+    "new_trace_id",
 ]

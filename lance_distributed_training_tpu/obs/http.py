@@ -14,6 +14,11 @@ framework: two GET routes and a 404.
   reported as ``"degraded"`` with the error) serves HTTP 503 so
   status-code-keyed probes can act on it — always as a fast, well-formed
   JSON body, never an unhandled 500 into a scraper's timeout path.
+
+:func:`build_info` is the shared "what is this process" block the
+``/healthz`` owners (DataService, Coordinator) merge in: package version,
+spoken protocol range, which opt-in runtime sanitizers are active, and
+uptime — the answer to "which build/config is the thing I'm scraping".
 """
 
 from __future__ import annotations
@@ -21,14 +26,54 @@ from __future__ import annotations
 import json
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 from .registry import MetricsRegistry, default_registry
 
-__all__ = ["MetricsHTTPServer"]
+__all__ = ["MetricsHTTPServer", "build_info"]
 
 _PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# Process-start anchor for uptime (module import ≈ process start for every
+# CLI entry; a duration, so monotonic — LDT601).
+_START_MONO = time.monotonic()
+
+
+def build_info() -> dict:
+    """Identify this running process: the ``/healthz`` build-info block.
+
+    Imports are lazy (and failure-tolerant) so a scrape can never break
+    on a partially-present build, and so this module keeps its
+    no-service-deps posture at import time."""
+    out: dict = {"uptime_s": round(time.monotonic() - _START_MONO, 1)}
+    try:
+        from .. import __version__
+
+        out["version"] = __version__
+    except Exception:  # noqa: BLE001 — health must not 500
+        out["version"] = "unknown"
+    try:
+        from ..service import protocol as P
+
+        out["protocol_versions"] = [
+            P.MIN_PROTOCOL_VERSION, P.PROTOCOL_VERSION
+        ]
+    except Exception:  # noqa: BLE001
+        pass
+    sanitizers = []
+    try:
+        from ..utils import compiletrack, leaktrack, wiretrack
+
+        for name, mod in (("leak", leaktrack), ("wire", wiretrack),
+                          ("compile", compiletrack)):
+            if mod.enabled():
+                sanitizers.append(name)
+    except Exception:  # noqa: BLE001
+        pass
+    out["sanitizers_active"] = sanitizers
+    return out
 
 
 class MetricsHTTPServer:

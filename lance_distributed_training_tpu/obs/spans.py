@@ -20,8 +20,17 @@ surfaces:
 
 Clocks: span durations come from ``time.monotonic_ns`` (LDT601 forbids
 ``time.time()`` here); the JSONL/export timestamps are the same monotonic
-microseconds, which Perfetto renders relative — absolute wall alignment
-across hosts is the lineage layer's job, not the tracer's.
+microseconds. For CROSS-process merge each JSONL additionally carries one
+``ldt.clock_sync`` anchor record (``wall_ns`` + ``mono_ns`` captured
+together — an epoch *stamp* that intentionally crosses process
+boundaries, the lineage clock policy) so ``ldt trace export`` can rebase
+every process onto one wall timeline; within a process all math stays
+monotonic.
+
+Ring-buffer truncation is observable, not silent: every span dropped off
+the full ring increments the ``spans_dropped_total`` counter, and JSONL
+files carry cumulative ``ldt.spans_dropped`` markers so ``ldt trace
+export`` can report how much the source processes truncated.
 """
 
 from __future__ import annotations
@@ -119,6 +128,7 @@ class SpanTracer:
         self._ids = itertools.count(1)  # GIL-atomic: id allocation is lockless
         self._jsonl = None
         self._jsonl_path = jsonl_path or os.environ.get("LDT_TRACE_PATH")
+        self._dropped = 0  # spans pushed off the full ring (see dropped)
 
     # -- recording ---------------------------------------------------------
 
@@ -129,10 +139,14 @@ class SpanTracer:
         return stack
 
     @contextmanager
-    def span(self, name: str, **attrs) -> Iterator[None]:
+    def span(self, name: str, **attrs) -> Iterator[dict]:
         """Record the enclosed block as one span; nests (parent = innermost
         open span on this thread) and mirrors into the jax profiler's host
-        timeline when a profiler trace is active."""
+        timeline when a profiler trace is active.
+
+        Yields the span's attrs dict so attributes only known mid-block
+        (``cache_hit``, result sizes) can be added before the span
+        closes: ``with span("x") as a: a["hit"] = True``."""
         stack = self._stack()
         span_id = next(self._ids)
         parent_id = stack[-1] if stack else 0
@@ -142,9 +156,9 @@ class SpanTracer:
         try:
             if annotation is not None:
                 with annotation:
-                    yield
+                    yield attrs
             else:
-                yield
+                yield attrs
         finally:
             end = time.monotonic_ns()
             stack.pop()
@@ -155,8 +169,20 @@ class SpanTracer:
             ))
 
     def _record(self, span: Span) -> None:
+        dropped = 0
         with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                # The ring evicts the oldest span to admit this one —
+                # count it so a truncated in-process trace is diagnosable
+                # (the old behavior dropped silently).
+                self._dropped += 1
+                dropped = self._dropped
             self._spans.append(span)
+        if dropped:
+            # Lazy import: registry never imports spans, so no cycle.
+            from .registry import default_registry
+
+            default_registry().counter("spans_dropped_total").inc()
         if self._jsonl_path is None:
             return
         # Serialize + flush outside the ring lock: a stalled disk slows the
@@ -164,6 +190,17 @@ class SpanTracer:
         # durability contract (`ldt trace export` must see spans from
         # processes that died mid-run).
         line = json.dumps(span.to_event()) + "\n"
+        if dropped and (dropped & (dropped - 1)) == 0:
+            # Cumulative drop marker at power-of-two counts: the ring in
+            # steady-state overflow drops one span per record, so a
+            # per-drop marker would double the file; doubling cadence
+            # keeps the count accurate within 2x at O(log n) lines.
+            line += json.dumps({
+                "name": "ldt.spans_dropped", "ph": "C",
+                "pid": span.pid, "tid": 0,
+                "ts": span.end_ns / 1e3,
+                "args": {"dropped": dropped},
+            }) + "\n"
         with self._io_lock:
             if self._jsonl_path is None:
                 return
@@ -173,6 +210,19 @@ class SpanTracer:
                 except OSError:
                     self._jsonl_path = None  # never retry a bad path
                     return
+                # One wall/monotonic anchor pair per (process, open):
+                # what lets `ldt trace export` place this process's
+                # monotonic timestamps on the shared wall timeline. An
+                # epoch stamp crossing processes — the LDT601-sanctioned
+                # use (see obs/lineage.py's clock policy).
+                self._jsonl.write(json.dumps({
+                    "name": "ldt.clock_sync", "ph": "M",
+                    "pid": os.getpid(), "tid": 0, "ts": 0,
+                    "args": {
+                        "wall_ns": time.time_ns(),
+                        "mono_ns": time.monotonic_ns(),
+                    },
+                }) + "\n")
             self._jsonl.write(line)
             self._jsonl.flush()
 
@@ -183,12 +233,22 @@ class SpanTracer:
         with self._lock:
             return list(self._spans)
 
+    @property
+    def dropped(self) -> int:
+        """Spans pushed off the full ring since construction."""
+        with self._lock:
+            return self._dropped
+
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
 
     def chrome_trace(self) -> dict:
-        return chrome_trace([s.to_event() for s in self.spans()])
+        out = chrome_trace([s.to_event() for s in self.spans()])
+        dropped = self.dropped
+        if dropped:
+            out["otherData"]["spans_dropped"] = dropped
+        return out
 
     def write_chrome_trace(self, path: str) -> str:
         """Dump the ring buffer as a Perfetto-loadable JSON file."""
@@ -241,39 +301,13 @@ def span(name: str, **attrs):
 # -- `ldt trace` CLI ---------------------------------------------------------
 
 
-def trace_main(argv=None, out=None) -> int:
-    """``ldt trace export`` — convert recorded span JSONL (written by any
-    process running with ``LDT_TRACE_PATH``) into one Chrome-trace JSON
-    loadable in Perfetto (https://ui.perfetto.dev) or chrome://tracing.
-    Returns the process exit status."""
-    import argparse
-    import sys
-
-    out = out if out is not None else sys.stdout
-    p = argparse.ArgumentParser(
-        prog="ldt trace",
-        description="Export recorded spans as a Perfetto-loadable "
-                    "Chrome-trace JSON",
-    )
-    sub = p.add_subparsers(dest="command")
-    exp = sub.add_parser("export", help="convert span JSONL → Chrome trace")
-    exp.add_argument(
-        "--spans", action="append", default=None, metavar="JSONL",
-        help="span JSONL file(s) written under LDT_TRACE_PATH (repeatable; "
-             "default: $LDT_TRACE_PATH or ldt-spans.jsonl)",
-    )
-    exp.add_argument("--out", default="ldt-trace.json",
-                     help="output Chrome-trace JSON path")
-    args = p.parse_args(list(argv) if argv is not None else None)
-    if args.command != "export":
-        p.print_help(out)
-        return 2
-    spans_paths = args.spans or [
-        os.environ.get("LDT_TRACE_PATH", "ldt-spans.jsonl")
-    ]
+def _load_span_events(paths: List[str], out) -> List[dict]:
+    """Merge span JSONL files into one event list (undecodable lines are
+    reported and skipped; missing files are reported — a silently dropped
+    host's spans read as "that host did nothing" in Perfetto)."""
     events: List[dict] = []
     missing = []
-    for path in spans_paths:
+    for path in paths:
         if not os.path.exists(path):
             missing.append(path)
             continue
@@ -290,22 +324,92 @@ def trace_main(argv=None, out=None) -> int:
                         f"{path}:{lineno}\n"
                     )
     if missing:
-        # A partial multi-process merge must say so: a silently dropped
-        # host's spans read as "that host did nothing" in Perfetto.
         out.write(
             f"ldt trace: missing span file(s): {', '.join(missing)}\n"
         )
-        if not events:
-            out.write(
-                "ldt trace: no events collected — run with "
-                "LDT_TRACE_PATH=<file> to record spans\n"
-            )
-            return 2
+    return events
+
+
+def trace_main(argv=None, out=None) -> int:
+    """``ldt trace export`` / ``ldt trace critical-path``.
+
+    * ``export`` merges span JSONLs (written by any process running with
+      ``LDT_TRACE_PATH``) into ONE Perfetto-loadable Chrome-trace JSON:
+      per-process clocks rebased onto the wall timeline via the
+      ``ldt.clock_sync`` anchors, cross-process batch chains stitched
+      with flow arrows (``obs/critpath.py``), ring-buffer drop counts
+      reported.
+    * ``critical-path`` analyzes the same merged events into per-batch
+      segment attribution + a straggler table.
+
+    Returns the process exit status."""
+    import argparse
+    import sys
+
+    out = out if out is not None else sys.stdout
+    p = argparse.ArgumentParser(
+        prog="ldt trace",
+        description="Merge and analyze recorded span JSONLs",
+    )
+    sub = p.add_subparsers(dest="command")
+    exp = sub.add_parser("export", help="convert span JSONL → Chrome trace")
+    cp = sub.add_parser(
+        "critical-path",
+        help="per-batch segment attribution + straggler table",
+    )
+    for sp in (exp, cp):
+        sp.add_argument(
+            "--spans", action="append", default=None, metavar="JSONL",
+            help="span JSONL file(s) written under LDT_TRACE_PATH "
+                 "(repeatable; default: $LDT_TRACE_PATH or "
+                 "ldt-spans.jsonl)",
+        )
+    exp.add_argument("--out", default="ldt-trace.json",
+                     help="output Chrome-trace JSON path")
+    cp.add_argument("--costs", default=None, metavar="JSONL",
+                    help="cost-ledger JSONL (LDT_COST_PATH) to join the "
+                         "straggler table against")
+    cp.add_argument("--top", type=int, default=10,
+                    help="slowest chains to show (default 10)")
+    args = p.parse_args(list(argv) if argv is not None else None)
+    if args.command not in ("export", "critical-path"):
+        p.print_help(out)
+        return 2
+    from .critpath import (
+        critical_path_main,
+        dropped_spans,
+        flow_events,
+        rebase_events,
+    )
+
+    spans_paths = args.spans or [
+        os.environ.get("LDT_TRACE_PATH", "ldt-spans.jsonl")
+    ]
+    events = _load_span_events(spans_paths, out)
+    if not events:
+        out.write(
+            "ldt trace: no events collected — run with "
+            "LDT_TRACE_PATH=<file> to record spans\n"
+        )
+        return 2
+    if args.command == "critical-path":
+        return critical_path_main(events, out, costs_path=args.costs,
+                                  top=args.top)
+    rebased, offsets = rebase_events(events)
+    flows = flow_events(rebased)
+    dropped = dropped_spans(events)
     with open(args.out, "w") as f:
-        json.dump(chrome_trace(events), f)
+        json.dump(chrome_trace(rebased + flows), f)
         f.write("\n")
     out.write(
-        f"ldt trace: wrote {len(events)} events to {args.out} — open it at "
-        "https://ui.perfetto.dev or chrome://tracing\n"
+        f"ldt trace: wrote {len(rebased)} events (+{len(flows)} flow "
+        f"arrows, {len(offsets)} process clocks aligned) to {args.out} — "
+        "open it at https://ui.perfetto.dev or chrome://tracing\n"
     )
+    if dropped:
+        out.write(
+            f"ldt trace: source ring buffers dropped ~{dropped} spans — "
+            "the merged trace is truncated (raise SpanTracer capacity "
+            "or rely on the JSONL, which never drops)\n"
+        )
     return 0

@@ -35,6 +35,7 @@ from typing import Callable, Iterator, Optional, Sequence
 from ..obs.lineage import observe_wire_lineage
 from ..obs.registry import MetricsRegistry, default_registry
 from ..obs.spans import span
+from ..obs.tracectx import child, coerce_trace
 from ..tune.tunable import AdjustableQueue, Tunable, _LiveQueues
 from ..utils.metrics import ServiceCounters
 from ..utils.retry import RetryPolicy, retrying
@@ -130,6 +131,9 @@ class RemoteLoader:
         # to the registry, the raw recent window here for tests/debugging.
         self.recent_lineage: deque = deque(maxlen=1024)
         self.last_lineage: Optional[dict] = None
+        # Last batch's continued trace context (v5): {trace_id, span_id,
+        # parent_span_id} after this hop — tests and debuggers peek here.
+        self.last_trace: Optional[dict] = None
         self.client_id = uuid.uuid4().hex
         # Version this client's HELLO advertises. Starts at the newest we
         # speak; a v1 server's equality check rejects that, so _connect
@@ -433,11 +437,24 @@ class RemoteLoader:
                     # frames the frombuffer copies cost real ms and would
                     # misattribute CPU time to the network.
                     recv_ns = time.time_ns()
-                    with span("client.decode", step=next_step):
-                        step, batch, lineage = P.decode_batch(
+                    with span("client.decode", step=next_step) as sp_attrs:
+                        step, batch, lineage, trace = P.decode_batch(
                             payload["raw"], with_lineage=True,
-                            pool=self.buffer_pool,
+                            with_trace=True, pool=self.buffer_pool,
                         )
+                        # Continue the server's causal chain (v5): this
+                        # receive hop becomes a CHILD of the remote send
+                        # span, so `ldt trace export` can draw the real
+                        # parent edge across processes.
+                        trace = coerce_trace(trace)
+                        if trace is not None:
+                            hop = child(trace)
+                            sp_attrs.update(
+                                trace_id=hop["trace_id"],
+                                trace_parent=hop["parent_span_id"],
+                                trace_span=hop["span_id"],
+                            )
+                            self.last_trace = hop
                     if step != next_step:
                         raise P.ProtocolError(
                             f"out-of-order step {step}, expected {next_step}"

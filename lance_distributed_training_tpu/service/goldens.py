@@ -112,13 +112,14 @@ def _frame(msg_type: int, payload: dict) -> bytes:
 
 
 def _batch_frame(step: int, batch: Dict[str, np.ndarray],
-                 lineage: Optional[dict]) -> bytes:
+                 lineage: Optional[dict],
+                 trace: Optional[dict] = None) -> bytes:
     """A MSG_BATCH frame through the real vectored send path
     (``tensor_views`` + ``send_batch_frame`` — byte-identical to
     ``encode_batch``, which the verify pass pins)."""
     metas, views = P.tensor_views(batch)
     meta = P.encode_batch_meta(step, metas, lineage,
-                               ragged=P.ragged_meta(batch))
+                               ragged=P.ragged_meta(batch), trace=trace)
     sink = _ByteSink()
     P.send_batch_frame(sink, meta, views)
     return sink.value()
@@ -223,6 +224,21 @@ _GOLDEN_LEASE = {
     "stripe_count": 4,
     "fragment_lo": 3,
     "fragment_hi": 6,
+}
+
+# Fixed trace context (v5 batch meta field, obs/tracectx.py shape). Real
+# ids come from os.urandom; the golden pins the FIELD layout, not entropy.
+_GOLDEN_TRACE = {
+    "trace_id": "00112233445566778899aabbccddeeff",
+    "span_id": "0123456789abcdef",
+}
+
+# Fixed mergeable queue-wait histogram (v5 heartbeat field): one count per
+# DEFAULT_MS_BUCKETS bound + the +Inf slot (17 entries).
+_GOLDEN_HIST = {
+    "counts": [0, 0, 1, 4, 9, 3, 1, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0],
+    "sum": 38.75,
+    "count": 18,
 }
 
 
@@ -363,12 +379,15 @@ GOLDEN_SPECS: List[GoldenSpec] = [
     # -- v4: the ragged token plane -----------------------------------------
     GoldenSpec(
         "v4_hello_full", 4, "MSG_HELLO",
-        lambda: _frame(P.MSG_HELLO, _hello_current()),
-        note="the newest default HELLO (all fields, no features engaged)",
+        lambda: _frame(P.MSG_HELLO, _hello_current(version=4)),
+        note="the v4 HELLO (all fields, no features engaged) — pinned at "
+             "version=4 since v5 became the default offer",
     ),
     GoldenSpec(
         "v4_hello_token_pack", 4, "MSG_HELLO",
-        lambda: _frame(P.MSG_HELLO, _hello_current(token_pack=True)),
+        lambda: _frame(P.MSG_HELLO, _hello_current(
+            version=4, token_pack=True,
+        )),
         note="ragged-plane HELLO: packing requested (honoured only at "
              "TOKEN_PACK_MIN_VERSION+; skew-checked against the server's "
              "serving mode)",
@@ -381,6 +400,39 @@ GOLDEN_SPECS: List[GoldenSpec] = [
         note="ragged token batch: values/offsets pages + pack plan + the "
              "derived meta 'ragged' field (capacity buckets)",
         batch=True,
+    ),
+    # -- v5: causal tracing + fleet SLO histograms --------------------------
+    GoldenSpec(
+        "v5_hello_full", 5, "MSG_HELLO",
+        lambda: _frame(P.MSG_HELLO, _hello_current()),
+        note="the newest default HELLO (all fields, no features engaged)",
+    ),
+    GoldenSpec(
+        "v5_batch_trace", 5, "MSG_BATCH",
+        lambda: _batch_frame(
+            4, _golden_tensors(), dict(_GOLDEN_LINEAGE),
+            trace=dict(_GOLDEN_TRACE),
+        ),
+        note="batch meta carrying the v5 trace field next to lineage "
+             "(omitted for older peers exactly like lineage)",
+        batch=True,
+    ),
+    GoldenSpec(
+        "v5_fleet_heartbeat_hist", 5, "MSG_FLEET_HEARTBEAT",
+        lambda: _frame(P.MSG_FLEET_HEARTBEAT, {
+            "server_id": "golden-server", "generation": 3,
+            "pressure": {
+                "stall_pct": 12.5, "active_clients": 1,
+                "queue_depth": 2.0, "batches_sent": 64,
+                "window_s": 2.0,
+            },
+            "queue_wait_hist": dict(
+                _GOLDEN_HIST, counts=list(_GOLDEN_HIST["counts"]),
+            ),
+        }),
+        note="heartbeat carrying the v5 mergeable queue-wait histogram "
+             "(bucket counts the coordinator sums into fleet-wide "
+             "percentiles; pre-v5 coordinators ignore the key)",
     ),
     GoldenSpec(
         "v3_fleet_register", 3, "MSG_FLEET_REGISTER",
@@ -477,14 +529,14 @@ def _roundtrip_errors(spec: GoldenSpec, data: bytes) -> List[str]:
         return errors
     if spec.batch:
         try:
-            step, batch, lineage = P.decode_batch(
-                payload, with_lineage=True
+            step, batch, lineage, trace = P.decode_batch(
+                payload, with_lineage=True, with_trace=True
             )
         except P.ProtocolError as exc:
             return [f"{spec.name}: decode_batch failed: {exc}"]
         sink = _ByteSink()
         P.send_frame(sink, P.MSG_BATCH, P.encode_batch(
-            step, batch, lineage
+            step, batch, lineage, trace=trace
         ))
         if sink.value() != data:
             errors.append(

@@ -62,6 +62,17 @@ client must require the negotiated version >= ``TOKEN_PACK_MIN_VERSION``
 instead of downgrade-retrying; a v3 (or non-packing v4) peer negotiates
 packing OFF and receives the exact bit-identical padded stream the
 pre-r15 protocol carried.
+
+Version 5 adds the optional **trace** field to the batch meta (a W3C-style
+cross-process trace context — ``{trace_id, span_id}``, see
+:mod:`..obs.tracectx`) and the optional **queue_wait_hist** field to fleet
+heartbeats (mergeable histogram bucket counts the coordinator aggregates
+into fleet-wide queue-wait percentiles). Both are backward compatible
+exactly like the v1/v2 lineage negotiation: the sender gates the trace
+field on the negotiated version (``TRACE_MIN_VERSION``) so pre-v5 peers
+receive byte-identical frames, an old decoder ignores the unknown meta
+key, and an old coordinator ignores the unknown heartbeat key — absence
+of either field is interop, never an error.
 """
 
 from __future__ import annotations
@@ -82,6 +93,7 @@ __all__ = [
     "LINEAGE_MIN_VERSION",
     "STRIPE_MIN_VERSION",
     "TOKEN_PACK_MIN_VERSION",
+    "TRACE_MIN_VERSION",
     "ragged_meta",
     "version_supported",
     "is_json_int",
@@ -116,10 +128,11 @@ __all__ = [
     "ProtocolError",
 ]
 
-PROTOCOL_VERSION = 4
+PROTOCOL_VERSION = 5
 # Oldest peer version this build still speaks. v1 framing is a strict
 # subset of v2 (no lineage meta key), an unstriped v3 HELLO is a strict
-# subset of v2's, and a pack-less v4 HELLO of v3's, so the floor stays 1.
+# subset of v2's, a pack-less v4 HELLO of v3's, and a v5 exchange differs
+# from v4 only by optional meta/heartbeat fields, so the floor stays 1.
 MIN_PROTOCOL_VERSION = 1
 # First version whose batch meta may carry the lineage field.
 LINEAGE_MIN_VERSION = 2
@@ -133,6 +146,11 @@ STRIPE_MIN_VERSION = 3
 # packed), never downgrade-retry; non-packing peers of any version get the
 # bit-identical padded stream.
 TOKEN_PACK_MIN_VERSION = 4
+# First version whose batch meta may carry the trace field (the
+# cross-process trace context, obs/tracectx.py). Downgrade-SAFE, like
+# lineage: the sender simply omits the field for older peers (their
+# frames stay byte-identical) and a receiver treats absence as None.
+TRACE_MIN_VERSION = 5
 # Error-message prefix every version rejection starts with — the marker the
 # client's downgrade retry keys on. FROZEN wire prose: deployed v1 servers
 # already say exactly "protocol version mismatch: server 1, client N", and
@@ -379,17 +397,20 @@ def ragged_meta(batch: dict) -> Optional[dict]:
 
 
 def encode_batch(step: int, batch: dict,
-                 lineage: Optional[dict] = None) -> bytes:
+                 lineage: Optional[dict] = None,
+                 trace: Optional[dict] = None) -> bytes:
     """One plan step's host batch → a MSG_BATCH payload.
 
     Arrays are serialised raw (C-contiguous dtype/shape + buffer), never
     pickled — the hot path moves bytes, not objects. ``lineage`` (v2+,
-    :mod:`..obs.lineage`) rides the JSON meta as an extra key: a v1 decoder
-    reads ``step``/``tensors`` and never sees it. Ragged token batches
-    (v4+) additionally carry the derived :func:`ragged_meta` field.
+    :mod:`..obs.lineage`) and ``trace`` (v5+, :mod:`..obs.tracectx`) ride
+    the JSON meta as extra keys: a v1 decoder reads ``step``/``tensors``
+    and never sees them. Ragged token batches (v4+) additionally carry
+    the derived :func:`ragged_meta` field.
     """
     metas, body = encode_tensors(batch)
-    meta = encode_batch_meta(step, metas, lineage, ragged=ragged_meta(batch))
+    meta = encode_batch_meta(step, metas, lineage,
+                             ragged=ragged_meta(batch), trace=trace)
     return b"".join([_META_LEN.pack(len(meta)), meta, body])
 
 
@@ -455,17 +476,21 @@ def _sendmsg_all(sock: socket.socket, views: list) -> None:
 
 def encode_batch_meta(step: int, tensor_metas: list,
                       lineage: Optional[dict] = None,
-                      ragged: Optional[dict] = None) -> bytes:
+                      ragged: Optional[dict] = None,
+                      trace: Optional[dict] = None) -> bytes:
     """The small JSON meta half of a MSG_BATCH payload (see
     :func:`encode_batch` for the lineage/v1 contract). ``ragged`` (v4+,
     :func:`ragged_meta`) names the batch's flat token-page tensors and
-    their capacity buckets; omitted when absent, so pre-ragged frames stay
-    byte-identical."""
+    their capacity buckets; ``trace`` (v5+, :mod:`..obs.tracectx`) is the
+    batch's cross-process trace context. Each is omitted when absent, so
+    pre-v5 (and pre-ragged, and pre-lineage) frames stay byte-identical."""
     header = {"step": int(step), "tensors": tensor_metas}
     if lineage is not None:
         header["lineage"] = lineage
     if ragged:
         header["ragged"] = ragged
+    if trace is not None:
+        header["trace"] = trace
     return json.dumps(header).encode("utf-8")
 
 
@@ -495,10 +520,14 @@ def send_batch_frame(sock: socket.socket, meta: bytes, body) -> int:
 
 
 def decode_batch(payload, with_lineage: bool = False,
-                 pool: Optional["BufferPool"] = None):
+                 pool: Optional["BufferPool"] = None,
+                 with_trace: bool = False):
     """MSG_BATCH payload → ``(step, {name: np.ndarray})``, or with
     ``with_lineage=True`` → ``(step, batch, lineage_or_None)`` (``None``
     when the sender predates — or gated off — the v2 lineage field).
+    ``with_trace=True`` (implies lineage) → ``(step, batch,
+    lineage_or_None, trace_or_None)`` — the v5 trace field, same
+    absence-is-interop contract.
 
     Arrays are copies (the frame buffer is reused by the receive loop), each
     materialised with one ``frombuffer`` + reshape — no element-wise work.
@@ -559,11 +588,15 @@ def decode_batch(payload, with_lineage: bool = False,
         raise ProtocolError(
             f"batch frame has {len(view) - offset} trailing bytes"
         )
-    if with_lineage:
+    if with_lineage or with_trace:
         lineage = meta.get("lineage")
-        return int(meta["step"]), out, (
-            lineage if isinstance(lineage, dict) else None
-        )
+        lineage = lineage if isinstance(lineage, dict) else None
+        if with_trace:
+            trace = meta.get("trace")
+            return int(meta["step"]), out, lineage, (
+                trace if isinstance(trace, dict) else None
+            )
+        return int(meta["step"]), out, lineage
     return int(meta["step"]), out
 
 

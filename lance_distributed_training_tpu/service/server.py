@@ -47,10 +47,13 @@ import threading
 import time
 from typing import Optional
 
+from ..data.cache import item_fingerprint
 from ..data.format import Dataset
 from ..data.graph import LanceSource
+from ..obs.costs import cost_context, default_ledger
 from ..obs.lineage import make_lineage
 from ..obs.spans import span
+from ..obs.tracectx import make_trace
 from ..utils.metrics import ServiceCounters
 from . import protocol as P
 
@@ -360,7 +363,7 @@ class _ClientSession:
                     return
                 if isinstance(item, BaseException):
                     raise item
-                step, metas, views, batch, lineage, enq_ns = item
+                step, metas, views, batch, lineage, trace, enq_ns = item
                 # Queue dwell = how long this client's consumption lagged
                 # decode; stamped HERE (not in the producer) so the value
                 # covers the whole wait and can still ride the frame.
@@ -379,7 +382,9 @@ class _ClientSession:
                 hook = svc.chaos
                 if hook is not None:
                     hook("send", self.peer, step)
-                with span("svc.send", step=step, peer=self.peer):
+                with span("svc.send", step=step, peer=self.peer,
+                          trace_id=trace["trace_id"],
+                          trace_span=trace["span_id"]):
                     if self.peer_version >= P.LINEAGE_MIN_VERSION:
                         lineage = dict(
                             lineage,
@@ -390,11 +395,16 @@ class _ClientSession:
                         lineage.pop("created_mono_ns", None)
                     else:  # v1 peer: omit the field (bit-identical v1)
                         lineage = None
+                    # Trace context (v5): like lineage, simply omitted for
+                    # older peers — their frames stay byte-identical.
+                    if self.peer_version < P.TRACE_MIN_VERSION:
+                        trace = None
                     # Ragged view declaration (v4): derived from the batch
                     # itself — None (field omitted) for every padded
                     # stream, so pre-ragged frames stay byte-identical.
                     meta = P.encode_batch_meta(
-                        step, metas, lineage, ragged=P.ragged_meta(batch)
+                        step, metas, lineage, ragged=P.ragged_meta(batch),
+                        trace=trace,
                     )
                     sent = P.send_batch_frame(self.sock, meta, views)
                 svc.counters.add("batches_sent")
@@ -425,7 +435,7 @@ class _ClientSession:
     def _release_item(self, item) -> None:
         """Give a drained sender-queue item's pooled pages back."""
         pool = self.service.buffer_pool
-        if pool is not None and isinstance(item, tuple) and len(item) == 6:
+        if pool is not None and isinstance(item, tuple) and len(item) == 7:
             pool.release_batch(item[3])
 
     def _produce(self, plan, steps, req: dict) -> None:
@@ -469,8 +479,21 @@ class _ClientSession:
                 if self._stop.is_set():
                     return
                 item = items[off]
+                # Trace context is born HERE, with the plan item — every
+                # downstream hop (send, client merge, train step) descends
+                # from this root so the exported flow has real parent
+                # edges. The ids come from os.urandom (tracectx) and never
+                # touch batch content (LDT1301).
+                trace = make_trace()
+                key = item_fingerprint(item)
+                cache_hit = False
                 t0 = time.monotonic_ns()
-                with span("svc.decode", step=step):
+                with cost_context(key, ledger=svc.cost_ledger,
+                                  step=step) as cost, \
+                     span("svc.decode", step=step,
+                          trace_id=trace["trace_id"],
+                          trace_span=trace["span_id"],
+                          item=key) as sp_attrs:
                     if miss_iter is not None and not (
                         probed is not None and probed[off]
                     ):
@@ -484,13 +507,24 @@ class _ClientSession:
                         batch = None
                         if cache is not None:
                             batch = cache.get(item, pool=svc.buffer_pool)
+                            cache_hit = batch is not None
                         if batch is None:
                             batch = self.decode_fn(
                                 svc.read_item(item, columns)
                             )
                             if cache is not None:
                                 cache.put(item, batch)
-                decode_ms = (time.monotonic_ns() - t0) / 1e6
+                    if cache_hit:
+                        sp_attrs["cache_hit"] = True
+                    decode_ms = (time.monotonic_ns() - t0) / 1e6
+                    cost.note(
+                        decode_ms=round(decode_ms, 3),
+                        cache_hit=cache_hit,
+                        bytes=sum(
+                            getattr(v, "nbytes", 0)
+                            for v in batch.values()
+                        ),
+                    )
                 svc.counters.observe("decode_ms", decode_ms)
                 lineage = make_lineage(step, decode_ms)
                 # Zero-join serialisation: flat views over the batch's own
@@ -501,7 +535,7 @@ class _ClientSession:
                 # pages once the frame is out.
                 metas, views = P.tensor_views(batch)
                 t1 = time.perf_counter()
-                self._q.put((step, metas, views, batch, lineage,
+                self._q.put((step, metas, views, batch, lineage, trace,
                              time.monotonic_ns()))
                 # Producer blocked = this client consumes slower than decode.
                 svc.counters.add("queue_full_s", time.perf_counter() - t1)
@@ -640,6 +674,15 @@ class DataService:
         # snapshot + its monotonic stamp. Touched only by pressure(), whose
         # single caller is the fleet agent's heartbeat thread.
         self._pressure_prev: tuple = ({}, time.monotonic())
+        # Per-item cost ledger (obs/costs.py): decode paths record into the
+        # process-wide singleton so `ldt costs` and /metrics see one view.
+        self.cost_ledger = default_ledger()
+        # SLO plane (obs/slo.py): burn-rate tracker over declared
+        # objectives, started with the metrics exporter. Its stall_pct
+        # probe keeps its OWN window anchor — pressure()'s anchor belongs
+        # to the heartbeat thread (single-caller contract above).
+        self._slo = None
+        self._slo_prev: tuple = ({}, time.monotonic())
 
     def pressure(self) -> dict:
         """Windowed pressure since the previous call — what this member
@@ -677,6 +720,43 @@ class DataService:
             "batches_sent": d("batches_sent"),
             "window_s": round(window_s, 3),
         }
+
+    def queue_wait_hist(self) -> Optional[dict]:
+        """Mergeable queue-wait histogram payload for fleet heartbeats
+        (protocol v5, version-gated by the agent like ``pressure``): the
+        ``svc_queue_wait_ms`` bucket counts + sum + count, which the
+        Coordinator sums across members to publish fleet-wide percentiles
+        (``fleet_queue_wait_p99_ms``). None until a batch has waited."""
+        hist = self.counters.registry.get("svc_queue_wait_ms")
+        if hist is None:
+            return None
+        counts, total_sum, count = hist.snapshot()
+        if not count:
+            return None
+        return {"counts": counts, "sum": total_sum, "count": count}
+
+    def _slo_stall_pct(self) -> float:
+        """SLO probe: windowed decode-starvation share, like pressure()'s
+        ``stall_pct`` but over this probe's own anchor (the SLO tick
+        thread), so neither caller shortens the other's window."""
+        now = time.monotonic()
+        snap = self.counters.snapshot()
+        prev, prev_t = self._slo_prev
+        self._slo_prev = (snap, now)
+        window_s = max(now - prev_t, 1e-6)
+        with self._sessions_lock:
+            active = len(self._sessions)
+        if not active:
+            return 0.0
+        d = (snap.get("svc_queue_empty_s", 0.0)
+             - prev.get("svc_queue_empty_s", 0.0))
+        return min(100.0, 100.0 * d / (window_s * active))
+
+    def _slo_queue_wait_p99(self) -> float:
+        hist = self.counters.registry.get("svc_queue_wait_ms")
+        if hist is None:
+            return float("nan")  # no traffic yet: probe skipped
+        return hist.percentile(99)
 
     # -- data plane --------------------------------------------------------
 
@@ -891,6 +971,17 @@ class DataService:
             self._log(
                 f"metrics on :{self.metrics_port} (/metrics, /healthz)"
             )
+            # SLO burn-down rides the metrics surface: no exporter, no
+            # consumer for the gauges, so no tick thread either.
+            from ..obs.slo import SLOTracker
+
+            self._slo = SLOTracker(
+                probes={
+                    "stall_pct": self._slo_stall_pct,
+                    "queue_wait_p99_ms": self._slo_queue_wait_p99,
+                },
+                registry=self.counters.registry,
+            ).start()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="ldt-svc-accept"
         )
@@ -919,6 +1010,10 @@ class DataService:
                 # member's windowed stall/occupancy so the coordinator can
                 # recommend scale-up/drain (README "Autotune").
                 pressure_fn=self.pressure,
+                # v5 fleet half of the SLO plane: mergeable queue-wait
+                # bucket counts, aggregated coordinator-side into
+                # fleet_queue_wait_p{50,95,99}_ms.
+                hist_fn=self.queue_wait_hist,
             ).start()
             self._log(
                 f"fleet member {self.fleet_agent.server_id} -> "
@@ -979,12 +1074,17 @@ class DataService:
                 # spot a heartbeat interval configured too close to it.
                 "lease_ttl_s": agent.lease_ttl_s,
             }
+        from ..obs.http import build_info
+
+        slo = self._slo  # snapshot: stop() nulls it concurrently
         return {
             # Non-"ok" serves as HTTP 503 (obs.http): a probe pointed here
             # sees the wind-down while the exporter thread lingers.
             "status": "degraded" if stopped else "ok",
             "dataset": self.config.dataset_path,
             "port": self.port,
+            "build": build_info(),
+            "slo": slo.status() if slo is not None else None,
             "active_clients": len(sessions),
             "stopped": stopped,
             "fleet": fleet,
@@ -1050,6 +1150,9 @@ class DataService:
 
     def stop(self) -> None:
         self._stopped.set()
+        if self._slo is not None:
+            self._slo.stop()
+            self._slo = None
         if self.fleet_agent is not None:
             # Graceful leave first: the coordinator reassigns the lease
             # now, not at TTL expiry, so clients restripe immediately.

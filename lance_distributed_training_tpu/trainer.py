@@ -1422,6 +1422,7 @@ def train(config: TrainConfig) -> dict:
     # RemoteLoader's svc_*/lineage_* series, pipeline_* batch ages — plus a
     # /healthz liveness body, for the lifetime of the run.
     exporter = None
+    slo_tracker = None  # SLO burn-down gauges, started with the exporter
     worker_pool = None
     batch_cache = None
     folder_fp = None  # folder-corpus fingerprint, computed once per run
@@ -1436,13 +1437,32 @@ def train(config: TrainConfig) -> dict:
             from .obs.http import MetricsHTTPServer
             from .obs.registry import default_registry
 
+            from .obs.slo import SLOTracker
+
+            def _lineage_p99(name: str):
+                def probe() -> float:
+                    hist = default_registry().get(name)
+                    if hist is None:
+                        return float("nan")  # no traffic yet: skipped
+                    return hist.percentile(99)
+                return probe
+
+            slo_tracker = SLOTracker(
+                probes={
+                    "batch_age_p99_ms": _lineage_p99("lineage_batch_age_ms"),
+                    "queue_wait_p99_ms": _lineage_p99(
+                        "lineage_queue_wait_ms"
+                    ),
+                },
+            ).start()
             exporter = MetricsHTTPServer(
                 default_registry(),
                 port=config.metrics_port,  # 0 = ephemeral, as serve-data
                 host=config.metrics_host,
                 healthz_fn=lambda: {"role": "trainer",
                                     "run_name": config.run_name,
-                                    "steps": timer.steps},
+                                    "steps": timer.steps,
+                                    "slo": slo_tracker.status()},
             ).start()
             logger.log({"metrics_port": exporter.port}, to_wandb=False)
         if not (config.data_service_addr or config.coordinator_addr):
@@ -1508,6 +1528,8 @@ def train(config: TrainConfig) -> dict:
             # Before the worker pool: a controller mid-tick must not
             # actuate a resize against a pool that is shutting down.
             tuner.stop()
+        if slo_tracker is not None:
+            slo_tracker.stop()
         if exporter is not None:
             exporter.stop()
         if worker_pool is not None:
@@ -1948,6 +1970,38 @@ def _train_loop(config, dataset, val_dataset, mesh, state, rng, train_step,
             epoch_metrics["unique_images_per_sec"] = (
                 epoch_metrics["images_per_sec"] / config.data_echo
             )
+        # Critical-path attribution over the epoch's in-ring spans
+        # (obs/critpath.py): which segment dominated the traced batch
+        # chains, plus the top-3 straggler item keys for the cost ledger.
+        # Only loopback/local runs see full chains (remote roots live in
+        # the server's tracer); failure-isolated — telemetry must never
+        # fail an epoch.
+        try:
+            from .obs.critpath import analyze as _critpath_analyze
+            from .obs.spans import default_tracer
+
+            _attrs = _critpath_analyze(
+                [s.to_event() for s in default_tracer().spans]
+            )
+            if _attrs:
+                epoch_metrics["critpath_coverage_pct"] = round(
+                    sum(a["coverage_pct"] for a in _attrs) / len(_attrs), 2
+                )
+                _dominants: dict = {}
+                for a in _attrs:
+                    _dominants[a["dominant"]] = (
+                        _dominants.get(a["dominant"], 0) + 1
+                    )
+                epoch_metrics["critpath_dominant"] = max(
+                    _dominants, key=_dominants.get
+                )
+                _stragglers = [
+                    str(a["item"])[:16] for a in _attrs[:3] if a.get("item")
+                ]
+                if _stragglers:
+                    epoch_metrics["straggler_items"] = ",".join(_stragglers)
+        except Exception:  # noqa: BLE001
+            pass
         if config.eval_every and (epoch + 1) % config.eval_every == 0:
             val_loader = _build_eval_loader(
                 config, eval_dataset, mesh, index_pool=eval_pool,

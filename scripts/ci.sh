@@ -54,6 +54,15 @@
 # build and re-encode byte-identically per version; the current encoders
 # must reproduce every blob exactly (constructor/framing drift fails the
 # gate; `ldt protocol goldens --update` regenerates a reviewable diff).
+# Stage 7e — trace smoke (scripts/trace_smoke.py): coordinator + 2
+# serve-data subprocesses + a real 1-epoch fleet train, every process
+# recording spans (LDT_TRACE_PATH) and servers recording per-item decode
+# costs (LDT_COST_PATH); the merged `ldt trace export` must stitch
+# cross-process batch chains with intact parent edges from BOTH servers
+# into the trainer, critical-path attribution must tile >= 90% of batch
+# wall, slo_* value+burn gauges must be live on a member /metrics, and
+# the coordinator /healthz must carry build info + fleet queue-wait
+# percentiles merged from both members' heartbeat histograms.
 # Stage 8 — the tier-1 verify command from ROADMAP.md, verbatim — run
 # under LDT_LOCK_SANITIZER=1, LDT_LEAK_SANITIZER=1, LDT_WIRE_SANITIZER=1
 # AND LDT_COMPILE_SANITIZER=1: every threading.Lock/RLock the package
@@ -200,6 +209,17 @@ echo "== token-pack smoke (padded-vs-packed waste cut, digest parity) =="
 # padded control arm, reproduce bit-identical per-step digests across
 # packed repeats, and strand zero ragged page leases under the sanitizer.
 timeout -k 10 540 env JAX_PLATFORMS=cpu LDT_LEAK_SANITIZER=1 PYTHONPATH=. python scripts/token_pack_smoke.py
+
+echo "== trace smoke (cross-process causal chains, costs, SLOs) =="
+# The r18 observability plane over real subprocesses: coordinator + 2
+# serve-data + a 1-epoch fleet train, every process recording spans under
+# its own LDT_TRACE_PATH (servers also LDT_COST_PATH). `ldt trace export`
+# must merge the four JSONLs with >=1 chain from EACH server reaching the
+# trainer (parent edges intact), critical-path attribution must tile
+# >=90% of batch wall, slo_* value+burn gauges must be live on a member
+# /metrics, and the coordinator /healthz must carry build info plus
+# queue-wait percentiles merged from BOTH members' heartbeat histograms.
+timeout -k 10 720 env JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/trace_smoke.py
 
 echo "== protocol goldens (cross-version byte-identity gate) =="
 # Every checked-in frame blob decodes with the current build and

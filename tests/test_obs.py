@@ -301,10 +301,13 @@ def test_span_jsonl_and_trace_export_cli(tmp_path):
     )
     assert rc == 0, buf.getvalue()
     data = json.load(open(out))  # acceptance: round-trips json.load
-    assert len(data["traceEvents"]) == 2
+    # Two spans plus the per-process ldt.clock_sync anchor (r18: the
+    # record that lets a multi-process merge rebase onto one wall clock).
     assert {e["name"] for e in data["traceEvents"]} == {
-        "svc.decode", "svc.send"
+        "ldt.clock_sync", "svc.decode", "svc.send"
     }
+    spans = [e for e in data["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == 2
 
 
 def test_trace_export_cli_missing_file(tmp_path):

@@ -36,7 +36,7 @@ def test_checked_in_corpus_round_trips():
 
 def test_corpus_covers_every_version_and_wire_message():
     versions = {s.version for s in G.GOLDEN_SPECS}
-    assert versions == {1, 2, 3, 4}
+    assert versions == {1, 2, 3, 4, 5}
     covered = {s.msg for s in G.GOLDEN_SPECS}
     wire_msgs = {n for n in dir(P) if n.startswith("MSG_")}
     assert covered == wire_msgs, (

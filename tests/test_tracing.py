@@ -1,0 +1,799 @@
+"""Fleet-wide causal tracing (r18): trace-context propagation over
+protocol v5, the per-item cost ledger, critical-path attribution, the SLO
+burn-rate plane, and the coordinator's mergeable queue-wait histograms.
+
+All fast (`not slow`): loopback servers in-thread, synthetic event lists
+for the analyzer, direct handler calls for the coordinator — the same
+harness style as tests/test_service.py / tests/test_tune.py.
+"""
+
+import io
+import json
+import math
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from lance_distributed_training_tpu.data import ImageClassificationDecoder
+from lance_distributed_training_tpu.data.pipeline import make_train_pipeline
+from lance_distributed_training_tpu.obs import MetricsRegistry
+from lance_distributed_training_tpu.obs import critpath
+from lance_distributed_training_tpu.obs.costs import (
+    CostLedger,
+    cost_context,
+    costs_main,
+    note_cost,
+)
+from lance_distributed_training_tpu.obs.registry import DEFAULT_MS_BUCKETS
+from lance_distributed_training_tpu.obs.slo import (
+    DEFAULT_SLOS,
+    SLOTracker,
+    parse_slos,
+)
+from lance_distributed_training_tpu.obs.spans import SpanTracer, trace_main
+from lance_distributed_training_tpu.obs.tracectx import (
+    child,
+    coerce_trace,
+    make_trace,
+)
+from lance_distributed_training_tpu.service import (
+    DataService,
+    RemoteLoader,
+    ServeConfig,
+)
+from lance_distributed_training_tpu.service import protocol as P
+
+pytestmark = pytest.mark.fast
+
+
+# -- trace context -----------------------------------------------------------
+
+
+def test_make_trace_and_child_shapes():
+    root = make_trace()
+    assert set(root) == {"trace_id", "span_id"}
+    assert len(root["trace_id"]) == 32 and len(root["span_id"]) == 16
+    int(root["trace_id"], 16), int(root["span_id"], 16)  # hex
+    hop = child(root)
+    assert hop["trace_id"] == root["trace_id"]  # same batch lifetime
+    assert hop["parent_span_id"] == root["span_id"]  # the causal edge
+    assert hop["span_id"] != root["span_id"]
+    # Entropy, not a counter: two batches never share a trace id.
+    assert make_trace()["trace_id"] != root["trace_id"]
+
+
+def test_coerce_trace_validates_peer_json():
+    good = make_trace()
+    assert coerce_trace(good) == good
+    hop = child(good)
+    assert coerce_trace(hop) == hop
+    # Uppercase hex normalises; junk parent is dropped, not fatal.
+    mixed = {"trace_id": good["trace_id"].upper(),
+             "span_id": good["span_id"], "parent_span_id": "not hex"}
+    out = coerce_trace(mixed)
+    assert out == {"trace_id": good["trace_id"],
+                   "span_id": good["span_id"]}
+    # Malformed overall → None, never a raise (wire-supplied JSON).
+    for bad in (None, "str", 7, [], {}, {"trace_id": good["trace_id"]},
+                {"trace_id": "zz", "span_id": good["span_id"]},
+                {"trace_id": "a" * 64, "span_id": good["span_id"]}):
+        assert coerce_trace(bad) is None, bad
+
+
+# -- protocol v5: the trace field on the wire --------------------------------
+
+
+def test_encode_batch_trace_roundtrip():
+    batch = {"x": np.arange(12, dtype=np.float32).reshape(3, 4)}
+    lineage = {"batch_seq": 2, "created_ns": 5, "decode_ms": 1.5}
+    trace = make_trace()
+    payload = P.encode_batch(9, batch, lineage, trace=trace)
+    step, out, lin, got = P.decode_batch(
+        payload, with_lineage=True, with_trace=True
+    )
+    assert step == 9 and lin == lineage and got == trace
+    np.testing.assert_array_equal(out["x"], batch["x"])
+    # An old-consumer decode (no with_trace) skips the field untouched.
+    step, out, lin = P.decode_batch(payload, with_lineage=True)
+    assert step == 9 and lin == lineage
+    # A traceless frame decodes trace as None — absence is interop.
+    bare = P.encode_batch(9, batch, lineage)
+    assert P.decode_batch(bare, with_lineage=True, with_trace=True)[3] is None
+
+
+def test_version_gates_cover_trace():
+    assert P.PROTOCOL_VERSION >= P.TRACE_MIN_VERSION == 5
+    assert P.MIN_PROTOCOL_VERSION == 1  # old peers still negotiate
+    assert P.hello(batch_size=1, process_index=0,
+                   process_count=1)["version"] == P.PROTOCOL_VERSION
+
+
+# -- live loopback: propagation + v4/v5 interop ------------------------------
+
+
+@pytest.fixture()
+def service(image_dataset):
+    svc = DataService(ServeConfig(
+        dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+        image_size=32, queue_depth=2,
+    )).start()
+    yield svc
+    svc.stop()
+
+
+def _loader(svc, **kw):
+    kw.setdefault("connect_retries", 2)
+    kw.setdefault("backoff_s", 0.01)
+    return RemoteLoader(f"127.0.0.1:{svc.port}", 16, 0, 1, **kw)
+
+
+def _local_batches(image_dataset):
+    return list(make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1,
+        ImageClassificationDecoder(image_size=32),
+    ))
+
+
+def test_trace_context_survives_the_wire(image_dataset, service):
+    """Acceptance: a v5 client's received batches carry a coerced child
+    context — same trace id family, parent edge back to the server's
+    segment — without touching batch content."""
+    loader = _loader(service)
+    local = _local_batches(image_dataset)
+    got = list(loader)
+    assert len(got) == len(local)
+    for a, b in zip(got, local):
+        np.testing.assert_array_equal(a["image"], b["image"])
+    hop = loader.last_trace
+    assert hop is not None
+    assert set(hop) == {"trace_id", "span_id", "parent_span_id"}
+    assert len(hop["trace_id"]) == 32
+    assert len(hop["parent_span_id"]) == 16  # the server's span id
+
+
+@pytest.mark.parametrize("version", [4, 5])
+def test_v4_v5_mixed_version_interop(image_dataset, service, version):
+    """Acceptance pin: a v4 client against the v5 server streams the
+    bit-identical batches with the trace field gated off (lineage, a
+    v2+ feature, still flows); a v5 client additionally gets traces."""
+    local = _local_batches(image_dataset)
+    loader = _loader(service)
+    loader._hello_version = version
+    got = list(loader)
+    assert len(got) == len(local)
+    for a, b in zip(got, local):
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+    assert len(loader.recent_lineage) == len(local)  # v2+ either way
+    if version >= P.TRACE_MIN_VERSION:
+        assert loader.last_trace is not None
+    else:
+        assert loader.last_trace is None  # field skipped, not fabricated
+
+
+def test_server_records_decode_costs(image_dataset, service):
+    """The decode seam feeds the cost ledger: one record per plan item,
+    keyed by the BatchCache content hash, with decode_ms + bytes."""
+    n = len(list(_loader(service)))
+    recs = service.cost_ledger.records()
+    assert len(recs) >= n
+    for rec in recs[:n]:
+        assert len(rec["key"]) == 64  # the BatchCache sha256 content hash
+        int(rec["key"], 16)
+        assert rec["decode_ms"] >= 0.0 and rec["bytes"] > 0
+    top = service.cost_ledger.top(3)
+    assert len(top) == 3
+    assert top[0]["decode_ms_max"] >= top[-1]["decode_ms_max"]
+
+
+# -- cost ledger -------------------------------------------------------------
+
+
+def test_cost_ledger_merge_flags_and_max():
+    led = CostLedger(registry=MetricsRegistry())
+    led.record("k1", decode_ms=10.0, bytes=100, cache_hit=False)
+    led.record("k1", decode_ms=4.0, bytes=100, cache_hit=True,
+               reencode=True)
+    (rec,) = led.records()
+    assert rec["n"] == 2
+    assert rec["decode_ms"] == 4.0  # latest observation
+    assert rec["decode_ms_max"] == 10.0  # the straggler signal
+    assert rec["cache_hit"] == 1 and rec["reencode"] == 1  # counts
+    # None key (unaddressable item) is dropped; junk field types too.
+    led.record(None, decode_ms=1.0)
+    led.record("k2", note="str ignored", decode_ms=float(2))
+    assert len(led.records()) == 2
+    assert "note" not in led.records()[-1]
+
+
+def test_cost_ledger_bounded_and_registry_series():
+    reg = MetricsRegistry()
+    led = CostLedger(capacity=3, registry=reg)
+    for i in range(5):
+        led.record(f"k{i}", decode_ms=float(i), bytes=10, entropy_ms=1.0)
+    recs = led.records()
+    assert len(recs) == 3  # oldest fell off
+    assert [r["key"] for r in recs] == ["k2", "k3", "k4"]
+    assert reg.get("cost_records_total").value == 5
+    assert reg.get("cost_bytes_total").value == 50
+    assert reg.get("cost_decode_ms").count == 5
+    assert reg.get("cost_entropy_ms").count == 5
+
+
+def test_cost_context_collects_note_cost():
+    led = CostLedger(registry=MetricsRegistry())
+    with cost_context("item", ledger=led, step=3) as cost:
+        note_cost(entropy_ms=2.5)  # a decode internal, unplumbed
+        cost.note(decode_ms=7.0)
+    (rec,) = led.records()
+    assert rec["step"] == 3 and rec["entropy_ms"] == 2.5
+    assert rec["decode_ms"] == 7.0
+    # Outside any context: a no-op, never a raise (worker processes).
+    note_cost(entropy_ms=99.0)
+    assert len(led.records()) == 1
+
+
+def test_cost_jsonl_and_report_cli(tmp_path):
+    path = tmp_path / "costs.jsonl"
+    led = CostLedger(registry=MetricsRegistry(), jsonl_path=str(path))
+    led.record("sha256:aaa", decode_ms=40.0, bytes=1000)
+    led.record("sha256:bbb", decode_ms=5.0, bytes=10)
+    led.record("sha256:aaa", decode_ms=50.0, bytes=1000)
+    led.close()
+    lines = [json.loads(x) for x in path.read_text().splitlines()]
+    assert len(lines) == 3 and all("ns" in x for x in lines)
+    buf = io.StringIO()
+    rc = costs_main(["report", "--costs", str(path), "--top", "2"], out=buf)
+    text = buf.getvalue()
+    assert rc == 0, text
+    assert "2 items, 3 observations" in text
+    # Straggler order: the re-observed slow item leads the table.
+    assert text.index("sha256:aaa") < text.index("sha256:bbb")
+    # Missing file: diagnosable failure, not a stack trace.
+    buf = io.StringIO()
+    assert costs_main(
+        ["report", "--costs", str(tmp_path / "nope.jsonl")], out=buf
+    ) == 2
+    assert "missing cost file" in buf.getvalue()
+
+
+# -- critical-path analyzer --------------------------------------------------
+
+
+def _synthetic_chain(trace_id="a" * 32, step=0, pid_a=100, pid_b=200,
+                     wall_a=1_000_000_000_000, wall_b=1_000_000_000_777):
+    """Two processes with deliberately skewed monotonic clocks: the
+    clock_sync anchors must rebase them onto one wall timeline. Times in
+    µs within each process's own monotonic domain."""
+    span_srv, span_cli = "b" * 16, "c" * 16
+    return [
+        # pid_a monotonic zero == wall_a µs; pid_b zero == wall_b µs.
+        {"name": critpath.CLOCK_SYNC_NAME, "ph": "M", "pid": pid_a,
+         "tid": 0, "ts": 0,
+         "args": {"wall_ns": wall_a * 1000, "mono_ns": 0}},
+        {"name": critpath.CLOCK_SYNC_NAME, "ph": "M", "pid": pid_b,
+         "tid": 0, "ts": 0,
+         "args": {"wall_ns": wall_b * 1000, "mono_ns": 0}},
+        {"name": "svc.decode", "ph": "X", "pid": pid_a, "tid": 1,
+         "ts": 0, "dur": 400,
+         "args": {"trace_id": trace_id, "trace_span": span_srv,
+                  "step": step, "item": "sha256:itm"}},
+        {"name": "svc.send", "ph": "X", "pid": pid_a, "tid": 1,
+         "ts": 500, "dur": 100,
+         "args": {"trace_id": trace_id, "trace_span": span_srv,
+                  "step": step}},
+        # pid_b local ts 0 == wall (wall_b); after rebase the wire gap is
+        # (wall_b) - (wall_a + 600) = 177 µs.
+        {"name": "client.decode", "ph": "X", "pid": pid_b, "tid": 2,
+         "ts": 0, "dur": 200,
+         "args": {"trace_id": trace_id, "trace_parent": span_srv,
+                  "trace_span": span_cli, "step": step}},
+        {"name": "train.step", "ph": "X", "pid": pid_b, "tid": 2,
+         "ts": 250, "dur": 300, "args": {"step": step}},
+    ]
+
+
+def test_rebase_and_flow_events():
+    events = _synthetic_chain()
+    rebased, offsets = critpath.rebase_events(events)
+    assert set(offsets) == {100, 200}
+    decode = next(e for e in rebased if e["name"] == "svc.decode")
+    recv = next(e for e in rebased if e["name"] == "client.decode")
+    assert decode["ts"] == pytest.approx(1_000_000_000_000)
+    assert recv["ts"] == pytest.approx(1_000_000_000_777)
+    flows = critpath.flow_events(rebased)
+    # One flow per trace id with >= 2 hops: start + continuations.
+    assert [f["ph"] for f in flows] == ["s", "t", "t"]
+    assert {f["id"] for f in flows} == {"a" * 16}
+
+
+def test_analyze_attributes_full_chain():
+    rebased, _ = critpath.rebase_events(_synthetic_chain())
+    (attr,) = critpath.analyze(rebased)
+    seg = attr["segments_ms"]
+    assert seg["decode"] == pytest.approx(0.4)
+    assert seg["queue_wait"] == pytest.approx(0.1)  # decode end → send
+    # Wire from send START (cross-clock, rebased): the 0.1 ms send span
+    # rides this segment — no tiling hole.
+    assert seg["wire"] == pytest.approx(0.277)
+    assert seg["merge"] == pytest.approx(0.2)
+    assert seg["h2d"] == pytest.approx(0.05)
+    assert seg["step"] == pytest.approx(0.3)
+    # Exhaustive tiling: this synthetic chain attributes 100% of wall.
+    assert attr["wall_ms"] == pytest.approx(1.327)
+    assert attr["coverage_pct"] == pytest.approx(100.0, abs=0.1)
+    assert attr["dominant"] == "decode"
+    assert attr["pids"] == [100, 200]
+    assert attr["step"] == 0 and attr["item"] == "sha256:itm"
+    assert attr["trace_id"] == "a" * 32
+
+
+def test_analyze_sorts_stragglers_and_marks_cache_hits():
+    events = _synthetic_chain(trace_id="a" * 32, step=0)
+    # A longer wire: the slow chain's client-side hops land 322 µs later
+    # (shared anchors — the chains ride the same two processes).
+    slow = [dict(e) for e in _synthetic_chain(trace_id="f" * 32, step=1)]
+    for ev in slow:
+        if ev["pid"] == 200 and ev["ph"] == "X":
+            ev["ts"] += 322
+    # A cache-served root attributes its duration to "cache".
+    hit = [dict(e) for e in _synthetic_chain(trace_id="e" * 32, step=2)]
+    for ev in hit:
+        if ev["name"] == "svc.decode":
+            ev["args"] = dict(ev["args"], cache_hit=True)
+    rebased, _ = critpath.rebase_events(events + slow[2:] + hit[2:])
+    attrs = critpath.analyze(rebased)
+    assert [a["step"] for a in attrs][0] == 1  # slowest first
+    by_step = {a["step"]: a for a in attrs}
+    assert by_step[1]["dominant"] == "wire"
+    assert "cache" in by_step[2]["segments_ms"]
+    assert "decode" not in by_step[2]["segments_ms"]
+
+
+def test_abandoned_send_never_joins_the_step():
+    """A sent-but-never-merged chain (stripe reconnect re-decodes its
+    steps; the in-flight frames are abandoned) must not claim the
+    train.step span that the RE-decoded chain actually fed — and its own
+    tiling stays exhaustive (the send span counts as wire)."""
+    events = _synthetic_chain(trace_id="a" * 32, step=0)
+    # Same step number, fresh trace id, no receive hop: the abandoned
+    # twin of step 0, decoded long before the trainer's step ran.
+    orphan = [dict(e) for e in _synthetic_chain(trace_id="d" * 32, step=0)
+              if e["name"] in ("svc.decode", "svc.send")]
+    rebased, _ = critpath.rebase_events(events + orphan)
+    by_trace = {a["trace_id"]: a for a in critpath.analyze(rebased)}
+    full, stub = by_trace["a" * 32], by_trace["d" * 32]
+    assert "step" in full["segments_ms"]
+    assert "step" not in stub["segments_ms"]  # no recv → no trainer join
+    # Orphan wall ends at send end; decode + queue_wait + the send span
+    # itself tile it completely.
+    assert stub["wall_ms"] == pytest.approx(0.6)
+    assert stub["segments_ms"]["wire"] == pytest.approx(0.1)
+    assert stub["coverage_pct"] == pytest.approx(100.0, abs=0.1)
+
+
+def test_dropped_spans_counts_max_marker_per_pid():
+    events = [
+        {"name": critpath.DROP_MARK_NAME, "ph": "C", "pid": 1,
+         "args": {"dropped": 2}},
+        {"name": critpath.DROP_MARK_NAME, "ph": "C", "pid": 1,
+         "args": {"dropped": 8}},  # cumulative: max wins
+        {"name": critpath.DROP_MARK_NAME, "ph": "C", "pid": 2,
+         "args": {"dropped": 3}},
+    ]
+    assert critpath.dropped_spans(events) == 11
+
+
+def test_critical_path_cli_reports_and_joins_costs(tmp_path):
+    spans = tmp_path / "spans.jsonl"
+    with open(spans, "w") as f:
+        for ev in _synthetic_chain():
+            f.write(json.dumps(ev) + "\n")
+    costs = tmp_path / "costs.jsonl"
+    costs.write_text(json.dumps(
+        {"key": "sha256:itm", "decode_ms": 0.4, "bytes": 64}
+    ) + "\n")
+    buf = io.StringIO()
+    rc = trace_main(
+        ["critical-path", "--spans", str(spans), "--costs", str(costs)],
+        out=buf,
+    )
+    text = buf.getvalue()
+    assert rc == 0, text
+    assert "1 batch chains" in text
+    assert "coverage 100.0% of wall" in text
+    assert "dominant segments: decode=1" in text
+    assert "cost[sha256:itm]" in text and "bytes=64" in text
+    # No chains (a traceless file): diagnosable exit 2.
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text(json.dumps(
+        {"name": "x", "ph": "X", "ts": 0, "dur": 1, "pid": 1, "tid": 1}
+    ) + "\n")
+    buf = io.StringIO()
+    assert trace_main(["critical-path", "--spans", str(bare)], out=buf) == 2
+    assert "no batch chains" in buf.getvalue()
+
+
+# -- span-drop accounting (satellite: no silent ring truncation) -------------
+
+
+def test_ring_drops_counted_and_reported(tmp_path):
+    from lance_distributed_training_tpu.obs.registry import default_registry
+
+    before = default_registry().counter("spans_dropped_total").value
+    jsonl = tmp_path / "spans.jsonl"
+    t = SpanTracer(capacity=2, jsonl_path=str(jsonl))
+    for i in range(5):
+        with t.span(f"s{i}"):
+            pass
+    t.close()
+    assert t.dropped == 3
+    assert default_registry().counter("spans_dropped_total").value \
+        == before + 3
+    # JSONL carries cumulative power-of-two markers (1, 2)...
+    names = [json.loads(x)["name"] for x in jsonl.read_text().splitlines()]
+    assert names.count(critpath.DROP_MARK_NAME) == 2
+    assert names[0] == critpath.CLOCK_SYNC_NAME  # rebase anchor first
+    # ...and the export surfaces the truncation instead of hiding it.
+    buf = io.StringIO()
+    rc = trace_main(["export", "--spans", str(jsonl),
+                     "--out", str(tmp_path / "t.json")], out=buf)
+    assert rc == 0
+    assert "dropped ~2 spans" in buf.getvalue()
+
+
+def test_span_yields_attrs_for_late_fields():
+    t = SpanTracer()
+    with t.span("probe", step=1) as attrs:
+        attrs["cache_hit"] = True
+    (s,) = t.spans()
+    assert s.attrs == {"step": 1, "cache_hit": True}
+
+
+# -- SLO plane ---------------------------------------------------------------
+
+
+def test_parse_slos_spec_and_defaults():
+    assert parse_slos(None) == DEFAULT_SLOS
+    assert parse_slos("  ") == DEFAULT_SLOS
+    (slo,) = parse_slos("stall_pct<=25@10")
+    assert slo.name == "stall_pct" and slo.threshold == 25.0
+    assert slo.budget_pct == 10.0
+    a, b = parse_slos("a<=1, b<=2")
+    assert (a.name, b.name) == ("a", "b") and b.budget_pct == 5.0
+    with pytest.raises(ValueError, match="name<=threshold"):
+        parse_slos("stall_pct=25")
+    with pytest.raises(ValueError, match="budget_pct"):
+        parse_slos("a<=1@0")
+
+
+def test_slo_tracker_burn_windows_and_nan_skip():
+    reg = MetricsRegistry()
+    values = {"stall_pct": 0.0}
+    tracker = SLOTracker(
+        probes={"stall_pct": lambda: values["stall_pct"]},
+        slos=parse_slos("stall_pct<=10@10,unprobed<=1"),
+        registry=reg,
+    )
+    assert [s.name for s in tracker.slos] == ["stall_pct"]  # probe-gated
+    now = 1000.0
+    for i in range(10):  # healthy minute: zero burn
+        tracker.tick(now=now + i)
+    assert reg.get("slo_stall_pct").value == 0.0
+    assert reg.get("slo_stall_pct_burn_1m").value == 0.0
+    values["stall_pct"] = 50.0  # hard violation from here on
+    for i in range(10, 20):
+        tracker.tick(now=now + i)
+    assert reg.get("slo_stall_pct").value == 50.0
+    # 10 of 20 samples violated over every window = 50% bad / 10% budget.
+    assert reg.get("slo_stall_pct_burn_1m").value == pytest.approx(5.0)
+    assert reg.get("slo_stall_pct_burn_1h").value == pytest.approx(5.0)
+    # NaN = not yet defined: skipped, gauges unchanged, no violation.
+    values["stall_pct"] = float("nan")
+    tracker.tick(now=now + 20)
+    assert reg.get("slo_stall_pct").value == 50.0
+    status = tracker.status()
+    assert status["stall_pct"]["threshold"] == 10.0
+    assert status["stall_pct"]["burn"]["1m"] == pytest.approx(5.0)
+
+
+def test_slo_probe_exception_is_nan_not_fatal():
+    reg = MetricsRegistry()
+
+    def boom():
+        raise RuntimeError("probe died")
+
+    tracker = SLOTracker(probes={"stall_pct": boom},
+                         slos=DEFAULT_SLOS, registry=reg)
+    tracker.tick(now=1.0)  # must not raise
+    assert reg.get("slo_stall_pct") is None  # nothing fabricated
+
+
+def test_slo_tracker_short_window_recovers_before_long():
+    """The multi-window point: after a burst ends, the 1m burn falls
+    while the 1h burn still remembers it."""
+    reg = MetricsRegistry()
+    values = {"v": 100.0}
+    tracker = SLOTracker(probes={"v": lambda: values["v"]},
+                         slos=parse_slos("v<=10@10"), registry=reg,
+                         interval_s=5.0)
+    now = 0.0
+    for i in range(6):  # 30 s of violation
+        tracker.tick(now=now + 5 * i)
+    values["v"] = 0.0
+    for i in range(6, 30):  # 2 healthy minutes
+        tracker.tick(now=now + 5 * i)
+    assert reg.get("slo_v_burn_1m").value == 0.0  # recovered
+    assert reg.get("slo_v_burn_1h").value > 0.0  # still remembers
+
+
+# -- DataService SLO probes + heartbeat histogram ----------------------------
+
+
+def test_service_queue_wait_hist_and_slo_probes(image_dataset):
+    from lance_distributed_training_tpu.utils.metrics import ServiceCounters
+
+    svc = DataService(ServeConfig(
+        dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+        image_size=32,
+    ))
+    # Fresh registry: the process-global one carries earlier tests' traffic.
+    svc.counters = ServiceCounters(registry=MetricsRegistry())
+    assert svc.queue_wait_hist() is None  # no traffic yet
+    assert math.isnan(svc._slo_queue_wait_p99())
+    for v in (1.0, 5.0, 250.0):
+        svc.counters.observe("queue_wait_ms", v)
+    hist = svc.queue_wait_hist()
+    assert hist["count"] == 3 and hist["sum"] == pytest.approx(256.0)
+    assert len(hist["counts"]) == len(DEFAULT_MS_BUCKETS) + 1
+    assert sum(hist["counts"]) == 3
+    assert svc._slo_queue_wait_p99() > 5.0
+    # The stall probe anchors its own window (never shortens pressure()'s).
+    assert svc._slo_stall_pct() == 0.0  # no sessions: nobody is starved
+    svc.counters.add("queue_empty_s", 10.0)
+    svc._sessions.add(object())
+    time.sleep(0.02)
+    assert svc._slo_stall_pct() == 100.0  # clamped: fully starved
+    svc._sessions.clear()
+
+
+def test_service_healthz_carries_build_and_slo(image_dataset, service):
+    health = service._healthz()
+    build = health["build"]
+    assert build["protocol_versions"] == [
+        P.MIN_PROTOCOL_VERSION, P.PROTOCOL_VERSION
+    ]
+    assert build["version"] and build["uptime_s"] >= 0.0
+    assert isinstance(build["sanitizers_active"], list)
+    assert "slo" in health  # None without metrics_port; block when started
+
+
+def test_service_with_metrics_port_serves_slo_gauges(image_dataset):
+    svc = DataService(ServeConfig(
+        dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+        image_size=32, metrics_port=0,
+    )).start()
+    try:
+        assert svc._slo is not None
+        svc.counters.observe("queue_wait_ms", 3.0)
+        svc._slo.tick()  # deterministic: don't wait for the 5 s ticker
+        base = f"http://127.0.0.1:{svc.metrics_port}"
+        text = urllib.request.urlopen(f"{base}/metrics", timeout=10) \
+            .read().decode()
+        assert "slo_queue_wait_p99_ms" in text
+        assert "slo_stall_pct" in text
+        health = json.loads(
+            urllib.request.urlopen(f"{base}/healthz", timeout=10).read()
+        )
+        assert health["build"]["version"]
+        assert "queue_wait_p99_ms" in health["slo"]
+    finally:
+        svc.stop()
+        assert svc._slo is None  # ticker stopped with the service
+
+
+# -- coordinator: fleet queue-wait aggregation -------------------------------
+
+
+def _hist_payload(*values):
+    h = MetricsRegistry().histogram("h")  # DEFAULT_MS_BUCKETS
+    for v in values:
+        h.observe(v)
+    counts, total_sum, count = h.snapshot()
+    return {"counts": counts, "sum": total_sum, "count": count}
+
+
+def _coordinator(**kw):
+    from lance_distributed_training_tpu.fleet.coordinator import (
+        Coordinator,
+        CoordinatorConfig,
+    )
+
+    return Coordinator(
+        CoordinatorConfig(host="127.0.0.1", port=0, **kw),
+        registry=MetricsRegistry(),
+    )
+
+
+def test_coordinator_merges_member_histograms():
+    """Acceptance: >= 2 members' heartbeat bucket counts merge into exact
+    fleet percentiles — gauges, resolve payload, and /healthz agree."""
+    coord = _coordinator()
+    for sid in ("s1", "s2"):
+        coord._handle_register({"server_id": sid, "addr": f"h:{sid[-1]}",
+                                "num_fragments": 4})
+    # Before any report: the surface says "not reporting", not zeros.
+    _, payload = coord._handle_resolve({})
+    assert payload["queue_wait_ms"] is None
+    assert coord.registry.get("fleet_queue_wait_p99_ms") is None
+    a_vals = [1.0] * 50
+    b_vals = [900.0] * 50  # the slow member dominates the fleet tail
+    coord._handle_heartbeat({"server_id": "s1",
+                             "queue_wait_hist": _hist_payload(*a_vals)})
+    coord._handle_heartbeat({"server_id": "s2",
+                             "queue_wait_hist": _hist_payload(*b_vals)})
+    _, payload = coord._handle_resolve({})
+    merged = payload["queue_wait_ms"]
+    assert merged["members"] == 2 and merged["count"] == 100
+    pooled = MetricsRegistry().histogram("pooled")
+    for v in a_vals + b_vals:
+        pooled.observe(v)
+    for q in (50, 95, 99):
+        assert merged[f"p{q}_ms"] == pytest.approx(
+            pooled.percentile(q), abs=1e-3
+        )
+        assert coord.registry.gauge(
+            f"fleet_queue_wait_p{q}_ms"
+        ).value == merged[f"p{q}_ms"]
+    # p50 sits between the calm and slow members; p99 is in the slow tail.
+    assert merged["p50_ms"] < merged["p99_ms"]
+    assert coord._healthz()["queue_wait_ms"] == merged
+
+
+def test_coordinator_skips_malformed_histograms():
+    coord = _coordinator()
+    for sid in ("good", "bad", "worse"):
+        coord._handle_register({"server_id": sid, "addr": "h:1",
+                                "num_fragments": 1})
+    coord._handle_heartbeat({"server_id": "good",
+                             "queue_wait_hist": _hist_payload(5.0, 7.0)})
+    # Wrong bucket layout and junk counts: degraded to "not reporting".
+    coord._handle_heartbeat({"server_id": "bad",
+                             "queue_wait_hist": {"counts": [1, 2, 3]}})
+    coord._handle_heartbeat({"server_id": "worse", "queue_wait_hist": {
+        "counts": ["x"] * (len(DEFAULT_MS_BUCKETS) + 1)}})
+    _, payload = coord._handle_resolve({})
+    merged = payload["queue_wait_ms"]
+    assert merged["members"] == 1 and merged["count"] == 2
+    # Non-dict field is ignored entirely (type gate at the handler).
+    coord._handle_heartbeat({"server_id": "bad", "queue_wait_hist": 7})
+
+
+def test_coordinator_healthz_carries_build_info():
+    coord = _coordinator()
+    build = coord._healthz()["build"]
+    assert build["protocol_versions"][1] == P.PROTOCOL_VERSION
+    assert build["version"]
+
+
+def test_agent_heartbeat_carries_hist_and_tolerates_probe_failure():
+    from lance_distributed_training_tpu.fleet.agent import FleetAgent
+
+    coord = _coordinator().start()
+    try:
+        addr = f"127.0.0.1:{coord.port}"
+        calls = {"n": 0}
+
+        def hist_fn():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("telemetry must not kill heartbeats")
+            if calls["n"] == 2:
+                return None  # no traffic yet: field omitted
+            return _hist_payload(40.0, 60.0)
+
+        agent = FleetAgent(addr, "127.0.0.1:9", server_id="m1",
+                           hist_fn=hist_fn, heartbeat_interval_s=60.0)
+        assert agent._register()
+        agent._heartbeat_once()  # raising probe: heartbeat still lands
+        agent._heartbeat_once()  # None: field omitted (pre-v5 shape)
+        with coord._lock:
+            assert coord._members["m1"].queue_wait_hist is None
+        agent._heartbeat_once()
+        _, payload = coord._handle_resolve({})
+        assert payload["queue_wait_ms"]["count"] == 2
+    finally:
+        coord.stop()
+
+
+# -- concurrent /metrics scrape (satellite: no torn renders) -----------------
+
+
+def test_metrics_scrape_hammer_no_torn_renders():
+    """Writer threads mutate the registry while scraper threads hammer
+    /metrics: every response must parse as Prometheus text with
+    internally-consistent histograms, counters must be monotonic across
+    one scraper's successive reads, and no thread may raise."""
+    from lance_distributed_training_tpu.obs import MetricsHTTPServer
+
+    reg = MetricsRegistry()
+    reg.counter("hammer_total")
+    reg.histogram("hammer_ms", buckets=(1.0, 10.0, 100.0))
+    srv = MetricsHTTPServer(reg, port=0, host="127.0.0.1",
+                            healthz_fn=lambda: {"hammer": True}).start()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        c = reg.counter("hammer_total")
+        h = reg.histogram("hammer_ms", buckets=(1.0, 10.0, 100.0))
+        g = reg.gauge("hammer_depth")
+        i = 0
+        while not stop.is_set():
+            c.inc()
+            h.observe(float(i % 200))
+            g.set(i)
+            i += 1
+
+    def scraper():
+        base = f"http://127.0.0.1:{srv.port}"
+        last_count = -1.0
+        try:
+            for _ in range(30):
+                text = urllib.request.urlopen(
+                    f"{base}/metrics", timeout=10
+                ).read().decode()
+                values = {}
+                for line in text.splitlines():
+                    if line.startswith("#") or not line.strip():
+                        continue
+                    name, _, value = line.rpartition(" ")
+                    values[name] = float(value)  # parses: not torn
+                count = values["hammer_total"]
+                assert count >= last_count, "counter went backwards"
+                last_count = count
+                # Bucket cumulativity holds inside one render.
+                buckets = [values[f'hammer_ms_bucket{{le="{b}"}}']
+                           for b in ("1", "10", "100", "+Inf")]
+                assert buckets == sorted(buckets), buckets
+                assert buckets[-1] == values["hammer_ms_count"]
+                json.loads(urllib.request.urlopen(
+                    f"{base}/healthz", timeout=10).read())
+        except Exception as exc:  # noqa: BLE001 — collected, not lost
+            errors.append(exc)
+
+    writers = [threading.Thread(target=writer) for _ in range(4)]
+    scrapers = [threading.Thread(target=scraper) for _ in range(4)]
+    try:
+        for t in writers + scrapers:
+            t.start()
+        for t in scrapers:
+            t.join(timeout=60)
+    finally:
+        stop.set()
+        for t in writers:
+            t.join(timeout=10)
+        srv.stop()
+    assert not errors, errors
+    assert reg.counter("hammer_total").value > 0
+
+
+# -- `ldt` CLI dispatch ------------------------------------------------------
+
+
+def test_cli_dispatches_costs_and_critical_path(tmp_path, capsys):
+    from lance_distributed_training_tpu import cli
+
+    costs = tmp_path / "c.jsonl"
+    costs.write_text(json.dumps({"key": "k", "decode_ms": 1.0}) + "\n")
+    assert cli.main(["costs", "report", "--costs", str(costs)]) == 0
+    assert "1 items" in capsys.readouterr().out
+    spans = tmp_path / "s.jsonl"
+    with open(spans, "w") as f:
+        for ev in _synthetic_chain():
+            f.write(json.dumps(ev) + "\n")
+    assert cli.main(["trace", "critical-path", "--spans", str(spans)]) == 0
+    assert "batch chains" in capsys.readouterr().out
