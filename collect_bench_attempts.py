@@ -22,6 +22,12 @@ disjoint line shapes, so mixing is harmless):
   regenerating an ATTEMPTS file from the full log list is lossless
   (pass ``--note`` to carry a root-cause annotation into the output).
 
+Campaign logs also carry host-side stage notes (``[campaign TS] host
+stage straggler: SUCCESS -> BENCH_STRAGGLER_r12.json`` — the CPU-basis
+artifacts the campaign runs before its probe loop); these parse into
+``kind: host_stage`` attempts so the ATTEMPTS record covers the whole
+campaign, not just the chip window hunt.
+
 Usage: python collect_bench_attempts.py [--note TEXT] LOG [LOG ...] OUT.json
 """
 
@@ -83,6 +89,7 @@ def _parse_campaign(log_path: str, batch: int, carry):
     log's leftover probe."""
     attempts = []
     last_probe = carry
+    host_counts: dict = {}  # stage name -> attempts seen in this log
     for line in open(log_path, errors="replace"):
         line = line.strip()
         if line.startswith("{"):
@@ -92,6 +99,23 @@ def _parse_campaign(log_path: str, batch: int, carry):
                 continue
             if j.get("probe"):
                 last_probe = j
+            continue
+        m = re.search(
+            r"\[campaign (\S+ \S+)\] host stage (\S+): (.+)", line)
+        if m:
+            ts, name, msg = m.group(1), m.group(2), m.group(3)
+            if msg.startswith("starting"):
+                continue  # the outcome note carries the evidence
+            host_counts[name] = host_counts.get(name, 0) + 1
+            a = {"batch": batch, "attempt": host_counts[name],
+                 "kind": "host_stage", "stage_name": name, "noted_at": ts}
+            if msg.startswith(("SUCCESS", "already complete")):
+                a["outcome"] = "complete"
+            elif msg.startswith("FAILED"):
+                a["outcome"] = "failed"
+            else:
+                a["outcome"] = msg[:120]
+            attempts.append(a)
             continue
         m = re.search(
             r"\[campaign (\S+ \S+)\] probe (\d+)(?:/\d+)?: (.+)", line)

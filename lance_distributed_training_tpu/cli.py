@@ -339,6 +339,15 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--no_shm_workers", action="store_true",
                    help="worker-pool IPC falls back to pickling decoded "
                         "batches instead of shared-memory ring slots")
+    p.add_argument("--sched_lookahead", type=int, default=0,
+                   help=">0: straggler-aware dispatch — reorder worker "
+                        "dispatch predicted-heaviest-first within this many "
+                        "buffered plan items (needs --num_workers > 0; the "
+                        "yielded stream stays in plan order, bit-identical)")
+    p.add_argument("--sched_heavy_share", type=int, default=0,
+                   help="percent of decode workers reserved as a dedicated "
+                        "heavy lane for items predicted far above the "
+                        "running mean (0 = single lane)")
     p.add_argument("--no_buffer_pool", action="store_true",
                    help="disable the recycled decode-buffer pool (every "
                         "batch faults a fresh allocation)")
@@ -554,6 +563,8 @@ def serve_main(argv=None) -> dict:
         image_size=args.image_size,
         num_workers=args.num_workers,
         shm_workers=not args.no_shm_workers,
+        sched_lookahead=args.sched_lookahead,
+        sched_heavy_share=args.sched_heavy_share,
         buffer_pool=not args.no_buffer_pool,
         device_decode=args.device_decode,
         token_pack=args.token_pack,

@@ -83,6 +83,7 @@ class FolderDataPipeline:
         buffer_pool=None,
         batch_cache=None,
         dataset_fingerprint=None,
+        scheduler=None,
     ):
         self.samples, self.classes = _folder_samples(root)
         if not self.samples:
@@ -115,6 +116,7 @@ class FolderDataPipeline:
         self.drop_last = drop_last
         self.prefetch = prefetch
         self.workers = workers
+        self.scheduler = scheduler
         self.producers = producers
         self.buffer_pool = buffer_pool
         self.batch_cache = batch_cache
@@ -220,6 +222,7 @@ class FolderDataPipeline:
             producers=self.producers,
             buffer_pool=self.buffer_pool,
             plan_cache=self._plan_cache(),
+            scheduler=self.scheduler,
         )
         pipe.load_state_dict({"step": self._start_step})
         self._yielded = self._start_step

@@ -361,6 +361,15 @@ class Decode(Node):
     declarations ride the HELLO handshake's skew checks so a
     differently-configured server is rejected at connect time, never
     mid-epoch.
+
+    ``schedule`` attaches straggler-aware dispatch at the decode seam
+    (worker-pool graphs only): a :class:`~.schedule.DecodeScheduler`, a
+    dict of its options (``{"lookahead": 8, "heavy_share": 25}``), or
+    ``True`` for defaults — compile builds the scheduler with a
+    :meth:`~.schedule.CostModel.from_env` warm-started cost model, so a
+    restarted job schedules from its ``LDT_COST_PATH`` history. Remote
+    graphs refuse it: the server owns dispatch
+    (``ServeConfig.sched_lookahead``/``sched_heavy_share``).
     """
 
     kind = "decode"
@@ -376,6 +385,7 @@ class Decode(Node):
         seq_len: Optional[int] = None,
         device_decode: Optional[bool] = None,
         token_pack: Optional[bool] = None,
+        schedule=None,
     ):
         self.decode_fn = decode_fn
         self.columns = columns
@@ -384,8 +394,17 @@ class Decode(Node):
         self.seq_len = seq_len
         self.device_decode = device_decode
         self.token_pack = token_pack
+        self.schedule = schedule
+        if schedule is not None:
+            # Instance override (the class default stays unchanged so
+            # schedule-less graphs — including every canonical describe
+            # golden — render exactly as before).
+            self.tunable_names = (
+                "coeff_chunk", "sched_lookahead", "sched_heavy_share",
+            )
 
     def detail(self) -> str:
+        sched = "" if self.schedule is None else " sched=on"
         if self.decode_fn is not None:
             name = getattr(
                 type(self.decode_fn), "__name__", str(self.decode_fn)
@@ -394,7 +413,7 @@ class Decode(Node):
                 "" if self.columns is None
                 else f" columns={list(self.columns)}"
             )
-            return f"fn={name}{cols}"
+            return f"fn={name}{cols}{sched}"
         declared = [
             f"{k}={v}"
             for k, v in (
@@ -626,6 +645,13 @@ class LoaderGraph:
                     "declaration-only (decode_fn=None, with task_type/"
                     "image_size/... riding the HELLO skew checks)"
                 )
+            if decode is not None and decode.schedule is not None:
+                raise ValueError(
+                    "remote transports dispatch server-side: drop "
+                    "schedule= from Decode and configure the DataService "
+                    "(ServeConfig.sched_lookahead / sched_heavy_share) "
+                    "instead"
+                )
             for kind in ("cache", "pool"):
                 node = self.node(kind)
                 payload = getattr(node, "batch_cache", None) or getattr(
@@ -709,7 +735,24 @@ class LoaderGraph:
             "workers": pool.workers,
             "buffer_pool": buffers.pool,
             "batch_cache": cache.batch_cache,
+            "scheduler": self._scheduler(decode),
         }
+
+    @staticmethod
+    def _scheduler(decode):
+        """Lower the Decode node's ``schedule`` spec to a live
+        :class:`~.schedule.DecodeScheduler` (instances pass through;
+        dicts/``True`` build one, warm-started from ``LDT_COST_PATH`` —
+        the restart-schedules-from-history wiring)."""
+        spec = getattr(decode, "schedule", None)
+        if spec is None:
+            return None
+        from .schedule import CostModel, DecodeScheduler
+
+        if isinstance(spec, DecodeScheduler):
+            return spec
+        opts = {} if spec is True else dict(spec)
+        return DecodeScheduler(CostModel.from_env(), **opts)
 
     def _build_lance(self, src: LanceSource):
         from .cache import PlanCache, decode_fingerprint, plan_fingerprint
@@ -739,6 +782,7 @@ class LoaderGraph:
             read_fn=_with_columns(_range_read, c["columns"]),
             workers=c["workers"], producers=c["producers"],
             buffer_pool=c["buffer_pool"], plan_cache=plan_cache,
+            scheduler=c["scheduler"],
         )
 
     def _build_map_style(self, src: MapStyleSource):
@@ -753,6 +797,7 @@ class LoaderGraph:
             workers=c["workers"], producers=c["producers"],
             columns=c["columns"], index_pool=src.index_pool,
             buffer_pool=c["buffer_pool"], batch_cache=c["batch_cache"],
+            scheduler=c["scheduler"],
         )
 
     def _build_folder(self, src: FolderSource):
@@ -772,6 +817,7 @@ class LoaderGraph:
             producers=c["producers"], buffer_pool=c["buffer_pool"],
             batch_cache=c["batch_cache"],
             dataset_fingerprint=src.dataset_fingerprint,
+            scheduler=c["scheduler"],
         )
 
     def _build_eval(self, src: EvalSource):

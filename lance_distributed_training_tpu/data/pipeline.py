@@ -139,6 +139,11 @@ class DataPipeline:
         worker processes instead of the producer thread (the reference's
         ``get_safe_loader``/``num_workers`` path,
         ``/root/reference/lance_map_style.py:60-69``).
+    scheduler: optional :class:`~.schedule.DecodeScheduler` — worker-pool
+        dispatch reorders predicted-heaviest-first within its lookahead
+        window (straggler-aware scheduling); yield order stays plan
+        order, so the stream is bit-identical. Ignored without
+        ``workers`` (in-process decode has no dispatch to reorder).
     """
 
     def __init__(
@@ -153,6 +158,7 @@ class DataPipeline:
         producers: int = 1,
         buffer_pool=None,
         plan_cache=None,
+        scheduler=None,
     ):
         self.dataset = dataset
         self.plan = list(plan)
@@ -161,6 +167,7 @@ class DataPipeline:
         self.prefetch = max(1, prefetch)
         self.read_fn = read_fn
         self.workers = workers
+        self.scheduler = scheduler
         self.producers = max(1, producers)
         # Batch-cache plane (data/cache.py): a PlanCache binding of the
         # process BatchCache, consulted AT the decode boundary — a hit
@@ -217,6 +224,8 @@ class DataPipeline:
         decoder = getattr(self.decode_fn, "tunables", None)
         if decoder is not None:
             out.extend(decoder())
+        if self.scheduler is not None:
+            out.extend(self.scheduler.tunables())
         return out
 
     def state_dict(self) -> dict:
@@ -261,6 +270,14 @@ class DataPipeline:
             cache.put(item, out)
         return out
 
+    def _worker_imap(self, items):
+        """The pool dispatch seam: straggler-aware when a scheduler is
+        attached (dispatch reordered, yield order unchanged — results
+        still arrive in plan order either way)."""
+        if self.scheduler is not None:
+            return self.scheduler.imap(self.workers, items)
+        return self.workers.imap(items)
+
     def _produce(self, q: "queue.Queue", stop: threading.Event,
                  plan: Sequence, base: int) -> None:
         """``plan`` is the resume-sliced tail; ``base`` keeps seq/lineage
@@ -277,12 +294,12 @@ class DataPipeline:
                     # consuming a worker result for a skipped item would
                     # shift every later batch one step (silent reorder).
                     probed = [cache.contains(item) for item in plan]
-                    it = self.workers.imap(
+                    it = self._worker_imap(
                         [i for i, hit in zip(plan, probed) if not hit]
                     )
                 else:
                     probed = None
-                    it = self.workers.imap(plan)
+                    it = self._worker_imap(plan)
                 for off, item in enumerate(plan):
                     seq = base + off
                     if stop.is_set():
@@ -540,6 +557,7 @@ def make_train_pipeline(
     columns: Optional[Sequence[str]] = None,
     buffer_pool=None,
     batch_cache=None,
+    schedule=None,
 ) -> "LoaderGraph":
     """Iterable-style pipeline — parity with ``get_sampler``+``get_dataset``+
     ``get_loader`` (``/root/reference/lance_iterable.py:53-72,86-88``).
@@ -572,7 +590,7 @@ def make_train_pipeline(
         LanceSource(dataset, sampler_type, batch_size, process_index,
                     process_count, shuffle=shuffle, seed=seed, epoch=epoch,
                     check_deadlock=check_deadlock),
-        Decode(decode_fn, columns=columns),
+        Decode(decode_fn, columns=columns, schedule=schedule),
         Cache(batch_cache),
         Pool(workers),
         Buffers(buffer_pool),
@@ -681,6 +699,7 @@ class MapStylePipeline:
         index_pool: Optional[np.ndarray] = None,
         buffer_pool=None,
         batch_cache=None,
+        scheduler=None,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -694,6 +713,7 @@ class MapStylePipeline:
         self.drop_last = drop_last
         self.prefetch = prefetch
         self.workers = workers
+        self.scheduler = scheduler
         self.producers = producers
         self.buffer_pool = buffer_pool
         self.batch_cache = batch_cache
@@ -731,6 +751,8 @@ class MapStylePipeline:
         decoder = getattr(self.decode_fn, "tunables", None)
         if decoder is not None:
             out.extend(decoder())
+        if self.scheduler is not None:
+            out.extend(self.scheduler.tunables())
         return out
 
     def set_epoch(self, epoch: int) -> None:
@@ -808,6 +830,7 @@ class MapStylePipeline:
             producers=self.producers,
             buffer_pool=self.buffer_pool,
             plan_cache=self._plan_cache(),
+            scheduler=self.scheduler,
         )
         # The cursor lives HERE (this is the consumer-facing loader); the
         # inner single-shot pipeline just starts at the same offset.
@@ -850,7 +873,8 @@ def make_map_style_pipeline(dataset: Dataset, *args, **kwargs) -> "LoaderGraph":
                        seed=a["seed"], epoch=a["epoch"],
                        drop_last=a["drop_last"],
                        index_pool=a["index_pool"]),
-        Decode(a["decode_fn"], columns=a["columns"]),
+        Decode(a["decode_fn"], columns=a["columns"],
+               schedule=a["scheduler"]),
         Cache(a["batch_cache"]),
         Pool(a["workers"]),
         Buffers(a["buffer_pool"]),

@@ -170,7 +170,7 @@ class _PoolState:
     bind a fixed executor (``resize`` swaps executors) — so everything
     teardown needs lives here, behind one lock."""
 
-    __slots__ = ("executor", "ring", "workers", "retired", "lock")
+    __slots__ = ("executor", "ring", "workers", "retired", "lanes", "lock")
 
     def __init__(self, executor, ring, workers: int):
         self.executor = executor
@@ -181,6 +181,11 @@ class _PoolState:
         # these BEFORE unlinking shm segments — a retired worker mid-slot-
         # write racing ring.cleanup() was the shutdown-during-resize bug.
         self.retired: list = []
+        # Dedicated lanes (ensure_lane): name -> (executor, worker count).
+        # Same spawn context + initargs as the main executor, so lane
+        # workers share the shm ring by session name exactly like resize's
+        # replacement executors do.
+        self.lanes: dict = {}
         self.lock = threading.Lock()
 
 
@@ -203,7 +208,9 @@ def _teardown_pool(state: _PoolState) -> None:
         executor = state.executor
         ring = state.ring
         retired = list(state.retired)
-        total_workers = state.workers + sum(n for _, _, n in retired)
+        lanes = list(state.lanes.values())
+        total_workers = (state.workers + sum(n for _, _, n in retired)
+                         + sum(n for _, n in lanes))
     if ring is not None:
         ring.poison(total_workers)
     for old, joiner, _ in retired:
@@ -211,6 +218,8 @@ def _teardown_pool(state: _PoolState) -> None:
         # Idempotent (the joiner already ran shutdown); cancel_futures covers
         # a joiner that timed out wedged.
         old.shutdown(wait=True, cancel_futures=True)
+    for lane_executor, _ in lanes:
+        lane_executor.shutdown(wait=True, cancel_futures=True)
     executor.shutdown(wait=True, cancel_futures=True)
     if ring is not None:
         ring.cleanup()
@@ -307,6 +316,16 @@ class WorkerPool:
     @property
     def closed(self) -> bool:
         return not self._finalizer.alive
+
+    @property
+    def dispatch_capacity(self) -> Optional[int]:
+        """Hard ceiling on concurrently-held in-flight items, or None
+        (pickle transport — unbounded). On the shm transport this is the
+        ring's slot count: a dispatcher holding results out of order
+        (the straggler scheduler) pins one slot per undelivered batch,
+        so exceeding it wedges workers on slot acquire until the 10 s
+        timeout drops them to the pickle fallback."""
+        return self._ring.nslots if self._ring is not None else None
 
     def resize(self, num_workers: int) -> int:
         """Grow or shrink the decode pool to ``num_workers`` WITHOUT
@@ -418,14 +437,59 @@ class WorkerPool:
             while pending:
                 yield _result(pending.popleft())
         finally:
-            for fut in pending:
-                # Cancel what hasn't started; running/done futures may hold
-                # shm slot tokens — reclaim them (non-blocking: the pool is
-                # persistent across epochs, so a lost token would shrink
-                # the ring forever; a blocking wait here would stall
-                # generator close behind in-flight decodes).
-                if not fut.cancel() and self._ring is not None:
-                    fut.add_done_callback(self._reclaim_slot)
+            self.abandon(pending)
+
+    def abandon(self, futs) -> None:
+        """Hand back in-flight futures nobody will consume (generator
+        close, decode error): cancel what hasn't started; running/done
+        futures may hold shm slot tokens — reclaim them (non-blocking:
+        the pool is persistent across epochs, so a lost token would
+        shrink the ring forever; a blocking wait here would stall
+        generator close behind in-flight decodes). Shared by
+        :meth:`imap` and the straggler scheduler's dispatch loop."""
+        for fut in futs:
+            if not fut.cancel() and self._ring is not None:
+                fut.add_done_callback(self._reclaim_slot)
+
+    def ensure_lane(self, lane: str, num_workers: int = 1) -> int:
+        """Create (idempotently) a dedicated named lane: a second
+        executor sharing this pool's spawn context, initargs, and shm
+        ring — the straggler scheduler's heavy lane, so one predicted
+        straggler never queues behind another. Sized once at first use;
+        torn down with the pool (:func:`_teardown_pool` poisons the slot
+        queue for lane workers too). Returns the lane's worker count."""
+        if num_workers < 1:
+            raise ValueError("lane needs num_workers >= 1")
+        if self.closed:
+            raise RuntimeError("WorkerPool is shut down")
+        state = self._state
+        with state.lock:
+            existing = state.lanes.get(lane)
+            if existing is not None:
+                return existing[1]
+            executor = ProcessPoolExecutor(
+                max_workers=num_workers,
+                mp_context=self._ctx,
+                initializer=_init_worker,
+                initargs=self._initargs,
+            )
+            state.lanes[lane] = (executor, num_workers)
+        default_registry().gauge("workers_lane_size").set(num_workers)
+        return num_workers
+
+    def submit_lane(self, item, lane: str = "default"):
+        """Submit one plan item to a named lane (``"default"`` is the
+        main executor — identical to the submission path :meth:`imap`
+        uses). Non-default lanes must exist (:meth:`ensure_lane`)."""
+        if lane == "default":
+            return self._submit(item)
+        with self._state.lock:
+            entry = self._state.lanes.get(lane)
+            if entry is None:
+                raise ValueError(
+                    f"unknown lane {lane!r} — call ensure_lane first"
+                )
+            return entry[0].submit(_run_item, item)
 
     def _submit(self, item):
         """Submit under the pool-state lock: ``resize`` swaps the executor

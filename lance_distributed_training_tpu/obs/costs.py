@@ -254,6 +254,7 @@ def costs_main(argv=None, out=None) -> int:
     ledger = CostLedger(capacity=1 << 20, jsonl_path=None,
                         registry=MetricsRegistry())
     lines = 0
+    parsed = []  # (key, fields) in file order, for the prediction replay
     for path in paths:
         if not os.path.exists(path):
             out.write(f"ldt costs: missing cost file {path}\n")
@@ -276,8 +277,31 @@ def costs_main(argv=None, out=None) -> int:
                         k: v for k, v in rec.items() if k not in ("key", "ns")
                     }
                     ledger.record(rec["key"], **fields)
+                    parsed.append((rec["key"], fields))
                     lines += 1
+    # Predicted-vs-actual replay (data/schedule.py CostModel): walk the
+    # ledger in recorded order, predicting each observation BEFORE folding
+    # it in — exactly the error the straggler scheduler would have run
+    # with. The per-key mean lands in the pred_err_ms column, so a
+    # mispredicted straggler is diagnosable straight from this table.
+    from ..data.schedule import CostModel
+
+    model = CostModel()
+    pred_err: dict = {}  # key -> [err_sum, n]
+    for key, fields in parsed:
+        ms = fields.get("decode_ms")
+        if not isinstance(ms, (int, float)):
+            continue
+        err = abs(model.predict(key, fields) - float(ms))
+        acc = pred_err.setdefault(key, [0.0, 0])
+        acc[0] += err
+        acc[1] += 1
+        model.observe(key, float(ms), fields)
     recs = ledger.records()
+    for rec in recs:
+        acc = pred_err.get(rec["key"])
+        if acc is not None:
+            rec["pred_err_ms"] = round(acc[0] / acc[1], 3)
     if not recs:
         out.write(
             "ldt costs: no records — run with LDT_COST_PATH=<file> to "
@@ -289,10 +313,14 @@ def costs_main(argv=None, out=None) -> int:
         f"ldt costs: {len(recs)} items, {total_n} observations "
         f"({lines} lines)\n"
     )
-    cols = ("n", "decode_ms_max", "decode_ms", "entropy_ms", "device_ms",
-            "bytes", "token_len", "reencode", "cache_hit")
+    cols = ("n", "decode_ms_max", "decode_ms", "pred_err_ms", "entropy_ms",
+            "device_ms", "bytes", "token_len", "reencode", "cache_hit")
     out.write("  " + " ".join(f"{c:>13}" for c in cols) + "  key\n")
-    for rec in ledger.top(args.top):
+    # Same straggler ordering as CostLedger.top(), over the annotated
+    # records (top() re-copies and would drop the pred_err_ms join).
+    recs.sort(key=lambda r: r.get("decode_ms_max", r.get("decode_ms", 0.0)),
+              reverse=True)
+    for rec in recs[:args.top]:
         row = " ".join(f"{rec.get(c, ''):>13}" for c in cols)
         out.write(f"  {row}  {rec['key'][:20]}\n")
     return 0

@@ -76,6 +76,14 @@ class ServeConfig:
     # (data/buffers.py) instead of being pickled across the IPC boundary;
     # False = legacy pickle transport (A/B control; auto-fallback when
     # POSIX shm is unavailable)
+    sched_lookahead: int = 0  # >0: straggler-aware dispatch at the
+    # worker-pool decode seam (data/schedule.py): dispatch reorders
+    # predicted-heaviest-first within this many buffered plan items (cost
+    # model warm-started from LDT_COST_PATH); yield order stays plan
+    # order, so every client stream is bit-identical to the unscheduled
+    # one. 0 = off; needs num_workers > 0 to have a dispatch to reorder.
+    sched_heavy_share: int = 0  # percent of decode workers reserved as a
+    # dedicated heavy lane for predicted stragglers (0 = single lane)
     buffer_pool: bool = True  # recycle decode/copy-out pages through the
     # process BufferPool (bufpool_* metrics show hit/miss on /metrics);
     # False = fault a fresh allocation per batch (the pre-r6 behavior)
@@ -474,7 +482,14 @@ class _ClientSession:
                     to_decode = [
                         i for i, hit in zip(items, probed) if not hit
                     ]
-                miss_iter = iter(svc.workers.imap(to_decode))
+                if svc.scheduler is not None:
+                    # Straggler-aware dispatch: same plan-order yield
+                    # contract, dispatch reordered by predicted cost.
+                    miss_iter = iter(
+                        svc.scheduler.imap(svc.workers, to_decode)
+                    )
+                else:
+                    miss_iter = iter(svc.workers.imap(to_decode))
             for off, step in enumerate(steps):
                 if self._stop.is_set():
                     return
@@ -654,6 +669,19 @@ class DataService:
                 retry_backoff_s=config.retry_backoff_s,
                 transport="shm" if config.shm_workers else "pickle",
                 buffer_pool=self.buffer_pool,
+            )
+        # Straggler-aware dispatch (data/schedule.py), shared by every
+        # client session: one cost model accumulates observations across
+        # sessions (concurrent updates race benignly — predictions are
+        # capacity-only advice; yield order never depends on them).
+        self.scheduler = None
+        if self.workers is not None and config.sched_lookahead > 0:
+            from ..data.schedule import CostModel, DecodeScheduler
+
+            self.scheduler = DecodeScheduler(
+                CostModel.from_env(),
+                lookahead=config.sched_lookahead,
+                heavy_share=config.sched_heavy_share,
             )
         self._plans: dict = {}  # handshake params -> per-process plans
         self._plans_lock = threading.Lock()

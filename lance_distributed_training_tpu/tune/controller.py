@@ -85,6 +85,13 @@ def derive_window(delta: Dict[str, float]) -> Dict[str, float]:
         p95 = delta.get(f"{source}_p95")
         if p95 is not None:
             w["decode_ms_p95"] = p95
+            # Straggler signal: tail-to-median skew of the SAME decode
+            # series. Near 1 = uniform item costs (more capacity is the
+            # only lever); large = a few items pin batch assembly — the
+            # straggler_bound rung grows sched_lookahead instead.
+            p50 = delta.get(f"{source}_p50")
+            if p50 is not None and p50 > 0:
+                w["decode_skew"] = p95 / p50
             break
     # Device-decode split attribution (the --device_decode arm): the host
     # entropy half's share of the per-batch decode cost. Near 1.0 = the
@@ -121,6 +128,12 @@ def derive_window(delta: Dict[str, float]) -> Dict[str, float]:
         # TRAINER process (the transform runs there), so it is present
         # even when decode is remote.
         w["pack_new_shapes"] = new_shapes
+    # Straggler scheduler (data/schedule.py): dispatch reorders this
+    # window. Present only when a scheduler ran — lets the policy (and
+    # `ldt trace` readers) tell "scheduler off" from "scheduler idle".
+    sched = delta.get("sched_dispatch_reorders_total")
+    if sched is not None:
+        w["sched_reorders"] = sched
     queue_wait = delta.get("svc_queue_wait_ms_p95")
     if queue_wait is not None:
         w["queue_wait_ms_p95"] = queue_wait

@@ -62,6 +62,11 @@ BOTTLENECK_CODES = {
     # --token_pack runs: the pack transform is paying fresh jit traces
     # every window — coarsen the quantum (fewer shapes, more padding).
     "recompile_bound": 8,
+    # Stalled while per-item decode cost is heavily skewed (p95/p50 of
+    # the decode series high): a few stragglers pin batch assembly —
+    # grow the scheduler's dispatch-reorder lookahead before throwing
+    # uniform capacity at a non-uniform problem.
+    "straggler_bound": 9,
 }
 
 # Capacity ladder for decode/transport-bound growth, in expected-payoff
@@ -116,6 +121,13 @@ class PolicyConfig:
     recompile_hi: float = 3.0  # --token_pack: fresh pack-transform jit
     # traces per window above which the rung coarsens pack_rows_quantum
     # (the opposite trade). Steady state sees 0 new shapes per window.
+    decode_skew_hi: float = 4.0  # straggler attribution: decode-latency
+    # tail-to-median ratio (decode_skew = p95/p50) above which a stall
+    # is straggler_bound — a few heavy items pin assembly, so the
+    # scheduler's sched_lookahead rung fires before the capacity ladder
+    # (growing workers adds uniform capacity; a skewed stall needs
+    # reordered dispatch). Uniform corpora sit near 1-2; the skewed
+    # bench corpus clears 4 comfortably.
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -241,6 +253,22 @@ class HillClimbPolicy:
                     _grow(knobs["bufpool_pages"],
                           bounds["bufpool_pages"][1]),
                     "pool_bound", stall, knobs,
+                )
+            skew = window.get("decode_skew", 0.0)
+            if skew >= c.decode_skew_hi and self._growable(
+                "sched_lookahead", knobs, bounds
+            ):
+                # Straggler rung: a skewed decode tail means a FEW items
+                # pin assembly — widen the scheduler's dispatch-reorder
+                # window before the uniform-capacity ladder (more workers
+                # cannot move a stall caused by one heavy item at the
+                # head of the line).
+                self.last_bottleneck = "straggler_bound"
+                return self._act(
+                    "sched_lookahead",
+                    _grow(knobs["sched_lookahead"],
+                          bounds["sched_lookahead"][1]),
+                    "straggler_bound", stall, knobs,
                 )
             device_bound = (
                 window.get("decode_split", 1.0) < c.decode_split_lo

@@ -63,6 +63,13 @@
 # wall, slo_* value+burn gauges must be live on a member /metrics, and
 # the coordinator /healthz must carry build info + fleet queue-wait
 # percentiles merged from both members' heartbeat histograms.
+# Stage 7f — straggler smoke (scripts/straggler_smoke.py): a skewed
+# corpus through one shared WorkerPool, plan-order vs DecodeScheduler —
+# sched_dispatch_reorders_total > 0 on a live /metrics scrape during the
+# warm scheduled epoch, per-step batch digests bit-identical to the
+# plan-order control arm (reordered dispatch is capacity, never
+# content), and zero leaked leases / shm ring slots under
+# LDT_LEAK_SANITIZER=1 despite out-of-order result holding.
 # Stage 8 — the tier-1 verify command from ROADMAP.md, verbatim — run
 # under LDT_LOCK_SANITIZER=1, LDT_LEAK_SANITIZER=1, LDT_WIRE_SANITIZER=1
 # AND LDT_COMPILE_SANITIZER=1: every threading.Lock/RLock the package
@@ -220,6 +227,14 @@ echo "== trace smoke (cross-process causal chains, costs, SLOs) =="
 # /metrics, and the coordinator /healthz must carry build info plus
 # queue-wait percentiles merged from BOTH members' heartbeat histograms.
 timeout -k 10 720 env JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/trace_smoke.py
+
+echo "== straggler smoke (reordered dispatch, digest parity, leak-clean) =="
+# One shared worker pool, two arms: the DecodeScheduler must actually
+# reorder dispatch on its warm epoch (live scrape of
+# sched_dispatch_reorders_total), the yielded stream must stay
+# bit-identical to plan order, and the out-of-order result holding must
+# release every ring slot (leak sanitizer on).
+timeout -k 10 300 env JAX_PLATFORMS=cpu LDT_LEAK_SANITIZER=1 PYTHONPATH=. python scripts/straggler_smoke.py
 
 echo "== protocol goldens (cross-version byte-identity gate) =="
 # Every checked-in frame blob decodes with the current build and

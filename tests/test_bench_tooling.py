@@ -147,6 +147,48 @@ def test_note_flag_missing_value_fails_before_clobbering(tmp_path):
     assert log.read_text() == CAMPAIGN_LOG_R4_DIALECT  # log untouched
 
 
+CAMPAIGN_LOG_HOST_STAGE = """\
+[campaign 2026-08-07 10:00:00] === campaign start (probes: unbounded, gap 540s) ===
+[campaign 2026-08-07 10:00:01] host stage straggler: starting (CPU basis, no chip window needed)
+[campaign 2026-08-07 10:03:22] host stage straggler: SUCCESS -> BENCH_STRAGGLER_r12.json
+[campaign 2026-08-07 10:03:23] host stage other: FAILED (artifact missing or not accepted)
+{"probe": "tpu_liveness", "ok": true, "value": 2097152.0}
+[campaign 2026-08-07 10:12:00] probe 1: chip healthy — running protocol
+"""
+
+
+def test_parse_campaign_host_stage_notes(tmp_path):
+    """Host-side stage notes (the CPU-basis artifacts the campaign runs
+    before probing) parse into kind: host_stage attempts; the "starting"
+    note is progress chatter, not an outcome, and probe parsing around
+    them is untouched."""
+    p = tmp_path / "c.log"
+    p.write_text(CAMPAIGN_LOG_HOST_STAGE)
+    attempts = parse_campaign_log(str(p), batch=1)
+    host = [a for a in attempts if a.get("kind") == "host_stage"]
+    assert [(a["stage_name"], a["outcome"], a["attempt"]) for a in host] == [
+        ("straggler", "complete", 1),
+        ("other", "failed", 1),
+    ]
+    probes = [a for a in attempts if a.get("kind") == "campaign_probe"]
+    (probe,) = probes
+    assert probe["outcome"] == "claimed"
+
+
+def test_campaign_registers_straggler_artifact():
+    """The straggler A/B is a registered host-side campaign stage: the
+    artifact name, its acceptance-gated completeness check (one JSON
+    object with accepted: true — stage_done's JSONL criterion does not
+    apply), and the pre-probe host_protocol call must all be present."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = open(os.path.join(root, "bench_campaign.sh")).read()
+    assert "BENCH_STRAGGLER_r12.json" in src
+    assert "straggler_done" in src
+    assert "host_protocol" in src
+    assert '.get("accepted") is True' in src
+    assert "bench_straggler.py" in src
+
+
 def test_probe_contract_stages_match_campaign_classifier():
     """bench_campaign.sh classifies outages by grepping the probe's JSON for
     stage names; if probe_tpu.py renames a stage the classifier silently

@@ -1,0 +1,569 @@
+"""Straggler-aware decode scheduling (data/schedule.py): reordered
+dispatch must be pure capacity — every loader shape streams bit-identical
+digests scheduler-on vs scheduler-off, resume cursors round-trip under
+reordered dispatch, and the cost model's cold-start estimates are
+deterministic (same corpus → same schedule, run over run).
+
+Unit tests drive a thread-backed FakePool exposing exactly the
+WorkerPool surface the scheduler uses; the integration half (process
+pools, loopback service, 2-member fleet) is `slow` like the rest of the
+worker tier.
+"""
+
+import json
+import re
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from lance_distributed_training_tpu.data.schedule import (
+    CostModel,
+    DecodeScheduler,
+    plan_item_hints,
+)
+from lance_distributed_training_tpu.data.cache import item_fingerprint
+from lance_distributed_training_tpu.obs.registry import MetricsRegistry
+from lance_distributed_training_tpu.utils.chaos import batch_digest
+
+
+# -- FakePool: the exact surface DecodeScheduler.imap drives ----------------
+
+
+class FakePool:
+    """Thread-backed WorkerPool stand-in: num_workers, submit_lane,
+    ensure_lane, abandon, _unwrap — nothing else."""
+
+    def __init__(self, fn, num_workers=2):
+        self._fn = fn
+        self.num_workers = num_workers
+        self._exec = ThreadPoolExecutor(num_workers)
+        self._lanes = {}
+        self.lane_items = []  # items routed off the default lane
+
+    def ensure_lane(self, lane, num_workers=1):
+        self._lanes.setdefault(lane, ThreadPoolExecutor(num_workers))
+        return num_workers
+
+    def submit_lane(self, item, lane="default"):
+        if lane == "default":
+            return self._exec.submit(self._fn, item)
+        self.lane_items.append(item)
+        return self._lanes[lane].submit(self._fn, item)
+
+    def abandon(self, futs):
+        for fut in futs:
+            fut.cancel()
+
+    def _unwrap(self, out):
+        return out
+
+    def shutdown(self):
+        for ex in [self._exec, *self._lanes.values()]:
+            ex.shutdown(wait=True)
+
+
+def _items(n, rows=4):
+    """n distinct map-style index arrays (same row count → identical
+    cold-start hints, distinct content fingerprints)."""
+    return [np.arange(i * rows, (i + 1) * rows, dtype=np.int64)
+            for i in range(n)]
+
+
+def _echo(item):
+    return {"ix": np.asarray(item)}
+
+
+def _run(sched, pool, items, **kw):
+    return list(sched.imap(pool, items, **kw))
+
+
+# -- plan-item hints --------------------------------------------------------
+
+
+def test_plan_item_hints_cover_every_plan_shape():
+    assert plan_item_hints(np.arange(6)) == {"rows": 6.0}
+    ev = (np.arange(4), np.arange(4))
+    assert plan_item_hints(ev) == {"rows": 4.0}
+
+    class RR:
+        def __init__(self, start, stop):
+            self.start, self.stop = start, stop
+
+    assert plan_item_hints([RR(0, 10), RR(20, 25)]) == {"rows": 15.0}
+    assert plan_item_hints("garbage") == {}
+    assert plan_item_hints([object()]) == {}
+
+
+# -- cost model -------------------------------------------------------------
+
+
+def test_cold_start_estimates_are_deterministic():
+    a, b = CostModel(), CostModel()
+    hints = {"rows": 16.0, "bytes": 200_000.0}
+    assert a.predict("k", hints) == b.predict("k", hints)
+    # More of anything costed costs more; reencode scales the estimate.
+    base = a.predict(None, {"rows": 4.0})
+    assert a.predict(None, {"rows": 8.0}) > base
+    assert a.predict(None, {"rows": 4.0, "bytes": 1e6}) > base
+    assert a.predict(None, {"rows": 4.0, "token_len": 2048}) > base
+    assert a.predict(None, {"rows": 4.0, "reencode": 1}) == pytest.approx(
+        2.0 * base
+    )
+
+
+def test_observe_folds_ema_and_learns_row_rate():
+    m = CostModel(decay=0.5)
+    m.observe("k", 100.0, {"rows": 10.0})
+    assert m.predict("k") == 100.0
+    m.observe("k", 0.0, {"rows": 10.0})
+    assert m.predict("k") == 50.0  # decayed, not replaced
+    assert len(m) == 1
+    # The learned per-row rate moved toward 10 ms/row, so unseen items
+    # with more rows now rank above items with fewer.
+    assert m.rate_snapshot() > 1.0
+    # A frozen rate keeps predictions a pure function of the hints.
+    r = m.rate_snapshot()
+    assert m.predict(None, {"rows": 3.0}, row_ms=r) == pytest.approx(
+        m._base_ms + 3.0 * r
+    )
+
+
+def test_priors_roundtrip_and_from_env(tmp_path, monkeypatch):
+    path = tmp_path / "costs.jsonl"
+    lines = [
+        json.dumps({"key": "hot", "decode_ms": 80.0, "bytes": 500_000}),
+        "not json at all {{{",
+        json.dumps(["not", "a", "dict"]),
+        json.dumps({"no_key_field": 1}),
+        json.dumps({"key": "hot", "decode_ms": 40.0}),
+        json.dumps({"key": "described", "bytes": 900_000, "reencode": 1}),
+    ]
+    path.write_text("\n".join(lines) + "\n")
+    m = CostModel(decay=0.5)
+    assert m.load_priors(str(path)) == 3  # garbage skipped, not fatal
+    assert m.predict("hot") == 60.0  # 80 then decayed toward 40
+    # Ledger-described-but-never-timed keys estimate from their bytes —
+    # above a totally unknown key's estimate.
+    assert m.predict("described") > m.predict("never-seen")
+    monkeypatch.setenv("LDT_COST_PATH", str(path))
+    warm = CostModel.from_env(decay=0.5)
+    assert warm.predict("hot") == 60.0
+    monkeypatch.setenv("LDT_COST_PATH", str(tmp_path / "absent.jsonl"))
+    assert len(CostModel.from_env()) == 0
+    assert CostModel().load_priors(str(tmp_path / "absent.jsonl")) == 0
+
+
+# -- dispatch loop ----------------------------------------------------------
+
+
+def test_cold_model_dispatches_in_plan_order_zero_reorders():
+    reg = MetricsRegistry()
+    pool = FakePool(_echo, num_workers=2)
+    try:
+        items = _items(12)
+        out = _run(DecodeScheduler(registry=reg), pool, items)
+        for got, item in zip(out, items):
+            np.testing.assert_array_equal(got["ix"], item)
+        # Uniform cold predictions tie → plan order → the counter
+        # honestly reads zero (no fake reorder inflation).
+        assert reg.counter("sched_dispatch_reorders_total").value == 0
+    finally:
+        pool.shutdown()
+
+
+def test_warm_model_reorders_dispatch_but_yields_plan_order():
+    reg = MetricsRegistry()
+    dispatch_order = []
+    lock = threading.Lock()
+
+    def fn(item):
+        with lock:
+            dispatch_order.append(int(np.asarray(item)[0]))
+        return _echo(item)
+
+    pool = FakePool(fn, num_workers=1)  # serial: dispatch order observable
+    try:
+        items = _items(8)
+        model = CostModel()
+        heavy = item_fingerprint(items[5])
+        for _ in range(3):
+            model.observe(heavy, 500.0, {"rows": 4.0})
+        sched = DecodeScheduler(model, lookahead=8, registry=reg)
+        out = _run(sched, pool, items, window=4)
+        for got, item in zip(out, items):  # yield order: the plan's
+            np.testing.assert_array_equal(got["ix"], item)
+        assert dispatch_order[0] == items[5][0]  # dispatch order: cost's
+        assert reg.counter("sched_dispatch_reorders_total").value > 0
+    finally:
+        pool.shutdown()
+
+
+def test_heavy_lane_routes_outliers_after_warmup():
+    reg = MetricsRegistry()
+    pool = FakePool(_echo, num_workers=4)
+    try:
+        items = _items(10)
+        model = CostModel()
+        for i in (6, 8):  # two far-above-mean stragglers (no row hints:
+            # the learned rate must not lift the cold baseline too)
+            model.observe(item_fingerprint(items[i]), 400.0)
+        sched = DecodeScheduler(model, lookahead=4, heavy_share=50,
+                                registry=reg)
+        out = _run(sched, pool, items)
+        for got, item in zip(out, items):
+            np.testing.assert_array_equal(got["ix"], item)
+        routed = reg.counter("sched_heavy_lane_batches_total").value
+        assert routed == len(pool.lane_items) > 0
+        # The lane got the predicted stragglers, nothing else.
+        lane_heads = {int(np.asarray(i)[0]) for i in pool.lane_items}
+        assert lane_heads <= {items[6][0], items[8][0]}
+    finally:
+        pool.shutdown()
+
+
+def test_starvation_guard_force_submits_the_yield_head():
+    reg = MetricsRegistry()
+    pool = FakePool(_echo, num_workers=2)
+    try:
+        items = _items(9)
+        model = CostModel()
+        # Adversarial: every LATER item predicts heavier than the head,
+        # so best-first dispatch would defer item 0 past the window.
+        for i, item in enumerate(items):
+            model.observe(item_fingerprint(item), 1.0 + i * 100.0,
+                          {"rows": 4.0})
+        sched = DecodeScheduler(model, lookahead=9, registry=reg)
+        out = _run(sched, pool, items, window=2)
+        for got, item in zip(out, items):
+            np.testing.assert_array_equal(got["ix"], item)
+        assert reg.counter("sched_dispatch_reorders_total").value > 0
+    finally:
+        pool.shutdown()
+
+
+def test_generator_close_abandons_inflight():
+    pool = FakePool(lambda item: (time.sleep(0.01), _echo(item))[1],
+                    num_workers=2)
+    try:
+        it = DecodeScheduler(registry=MetricsRegistry()).imap(
+            pool, _items(16)
+        )
+        next(it)
+        it.close()  # must not hang; in-flight futures handed to abandon()
+    finally:
+        pool.shutdown()
+
+
+def test_prediction_error_histogram_observes_per_item():
+    reg = MetricsRegistry()
+    pool = FakePool(_echo, num_workers=2)
+    try:
+        _run(DecodeScheduler(registry=reg), pool, _items(6))
+        assert reg.histogram("sched_predicted_error_ms").count == 6
+    finally:
+        pool.shutdown()
+
+
+# -- knobs ------------------------------------------------------------------
+
+
+def test_constructor_validates_bounds():
+    with pytest.raises(ValueError, match="lookahead"):
+        DecodeScheduler(lookahead=0)
+    with pytest.raises(ValueError, match="heavy_share"):
+        DecodeScheduler(heavy_share=101)
+    with pytest.raises(ValueError, match="decay"):
+        CostModel(decay=0.0)
+
+
+def test_tunables_clamp_to_bounds():
+    sched = DecodeScheduler(lookahead=8, heavy_share=10)
+    knobs = {t.name: t for t in sched.tunables()}
+    assert set(knobs) == {"sched_lookahead", "sched_heavy_share"}
+    assert knobs["sched_lookahead"].set(10_000) == 64 == sched.lookahead
+    assert knobs["sched_lookahead"].set(0) == 1 == sched.lookahead
+    assert knobs["sched_heavy_share"].set(200) == 50 == sched.heavy_share
+    assert knobs["sched_heavy_share"].set(-3) == 0 == sched.heavy_share
+    assert knobs["sched_lookahead"].get() == 1
+
+
+# -- autotune wiring --------------------------------------------------------
+
+
+def test_policy_straggler_rung_fires_on_skew():
+    from lance_distributed_training_tpu.tune.policy import (
+        BOTTLENECK_CODES,
+        HillClimbPolicy,
+        PolicyConfig,
+    )
+
+    assert BOTTLENECK_CODES["straggler_bound"] == 9
+    bounds = {"workers": (1, 8), "prefetch": (1, 16),
+              "sched_lookahead": (1, 64)}
+    knobs = {"workers": 2, "prefetch": 2, "sched_lookahead": 8}
+    window = {"steps": 10.0, "stall_pct": 80.0, "h2d_pct": 0.0,
+              "decode_skew": 5.0}
+    p = HillClimbPolicy(PolicyConfig(min_steps=1))
+    out = p.decide(window, knobs, bounds)
+    assert [(d.knob, d.target, d.reason) for d in out] == [
+        ("sched_lookahead", 16, "straggler_bound")
+    ]
+    # Low skew → the rung stays silent and the capacity ladder runs.
+    p2 = HillClimbPolicy(PolicyConfig(min_steps=1))
+    calm_skew = dict(window, decode_skew=1.2)
+    assert p2.decide(calm_skew, knobs, bounds)[0].knob == "workers"
+
+
+def test_derive_window_exposes_skew_and_reorders():
+    from lance_distributed_training_tpu.tune.controller import derive_window
+
+    w = derive_window({
+        "trainer_step_ms_count": 10.0,
+        "pipeline_decode_ms_p95": 80.0,
+        "pipeline_decode_ms_p50": 10.0,
+        "sched_dispatch_reorders_total": 3.0,
+    })
+    assert w["decode_skew"] == pytest.approx(8.0)
+    assert w["sched_reorders"] == 3.0
+    assert "decode_skew" not in derive_window({
+        "trainer_step_ms_count": 10.0,
+        "pipeline_decode_ms_p95": 80.0,
+    })
+
+
+# -- LDT1301 pin ------------------------------------------------------------
+
+
+def test_schedule_is_hot_path_not_content_path():
+    """schedule.py reads clocks and predicts — legal in [hot-paths],
+    banned from [content-paths] (nothing here may feed plan, batch, or
+    cursor bytes). Pin the pyproject listing so a refactor can't quietly
+    move it."""
+    text = Path(__file__).resolve().parents[1].joinpath(
+        "pyproject.toml"
+    ).read_text()
+
+    def paths(section):
+        m = re.search(section + r"\s*=\s*\[(.*?)\]", text, re.S)
+        assert m, f"missing {section} in pyproject.toml"
+        return re.findall(r'"([^"]+)"', m.group(1))
+
+    target = "lance_distributed_training_tpu/data/schedule.py"
+    assert target in paths("hot-paths")
+    assert target not in paths("content-paths")
+
+
+# -- integration: the five loader shapes (slow tier) ------------------------
+
+
+@pytest.fixture(scope="module")
+def sched_dataset(tmp_path_factory):
+    import pyarrow as pa
+
+    from lance_distributed_training_tpu.data import write_dataset
+    from tests.conftest import make_jpeg
+
+    rng = np.random.default_rng(11)
+    images = [make_jpeg(rng) for _ in range(96)]
+    labels = rng.integers(0, 10, 96)
+    table = pa.table(
+        {"image": pa.array(images, pa.binary()),
+         "label": pa.array(labels, pa.int64())}
+    )
+    uri = tmp_path_factory.mktemp("sched") / "ds"
+    return write_dataset(table, uri, mode="create", max_rows_per_file=40)
+
+
+@pytest.fixture(scope="module")
+def sched_pool(sched_dataset):
+    from lance_distributed_training_tpu.data import ImageClassificationDecoder
+    from lance_distributed_training_tpu.data.workers import (
+        WorkerPool,
+        columnar_spec,
+    )
+
+    decode = ImageClassificationDecoder(image_size=32)
+    with WorkerPool(columnar_spec(sched_dataset.uri), decode, 2) as p:
+        yield p
+
+
+def _digests(loader):
+    return [batch_digest(b) for b in loader]
+
+
+SCHED = {"lookahead": 6, "heavy_share": 50}
+
+
+@pytest.mark.slow
+def test_iterable_pipeline_bit_identical_and_resumes(sched_dataset,
+                                                     sched_pool):
+    from lance_distributed_training_tpu.data import ImageClassificationDecoder
+    from lance_distributed_training_tpu.data.pipeline import (
+        make_train_pipeline,
+    )
+
+    decode = ImageClassificationDecoder(image_size=32)
+    kwargs = dict(
+        dataset=sched_dataset, sampler_type="batch", batch_size=16,
+        process_index=0, process_count=1, decode_fn=decode,
+        workers=sched_pool, shuffle=True, seed=3,
+    )
+    ref = _digests(make_train_pipeline(**kwargs))
+    assert len(ref) == 6
+    sched = make_train_pipeline(schedule=SCHED, **kwargs)
+    assert _digests(sched) == ref  # bit-identical stream
+    # Scheduler knobs surface at the graph root for collect_tunables.
+    names = {t.name for t in sched.tunables()}
+    assert {"sched_lookahead", "sched_heavy_share"} <= names
+    # Mid-epoch resume round-trips under reordered dispatch: the cursor
+    # is plan position, which dispatch order never touches.
+    resumed = make_train_pipeline(schedule=SCHED, **kwargs)
+    resumed.load_state_dict({"step": 3})
+    assert _digests(resumed) == ref[3:]
+    assert resumed.state_dict() == {"step": 6}
+
+
+@pytest.mark.slow
+def test_map_style_pipeline_bit_identical(sched_dataset, sched_pool):
+    from lance_distributed_training_tpu.data import (
+        ImageClassificationDecoder,
+        MapStylePipeline,
+    )
+
+    decode = ImageClassificationDecoder(image_size=32)
+    kwargs = dict(workers=sched_pool, seed=5, shuffle=True)
+    ref = _digests(MapStylePipeline(
+        sched_dataset, 16, 0, 1, decode, **kwargs))
+    sched = DecodeScheduler(**SCHED)
+    got = _digests(MapStylePipeline(
+        sched_dataset, 16, 0, 1, decode, scheduler=sched, **kwargs))
+    assert got == ref
+
+
+@pytest.mark.slow
+def test_folder_pipeline_bit_identical(image_folder):
+    from lance_distributed_training_tpu.data import (
+        FolderDataPipeline,
+        ImageClassificationDecoder,
+    )
+    from lance_distributed_training_tpu.data.workers import (
+        WorkerPool,
+        folder_spec,
+    )
+
+    decode = ImageClassificationDecoder(image_size=32)
+    pipe = FolderDataPipeline(image_folder, 10, 0, 1, decode, shuffle=True,
+                              seed=2)
+    samples = pipe.samples
+    ref = _digests(pipe)
+    with WorkerPool(folder_spec(samples), decode, 2) as pool:
+        got = _digests(FolderDataPipeline(
+            image_folder, 10, 0, 1, decode, shuffle=True, seed=2,
+            workers=pool, scheduler=DecodeScheduler(**SCHED)))
+    assert got == ref
+
+
+@pytest.fixture()
+def image_folder(tmp_path):
+    """root/<class>/<img>.jpg tree, 3 classes x 10 images."""
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    root = tmp_path / "folder"
+    for cls in ["apple", "banana", "cherry"]:
+        d = root / cls
+        d.mkdir(parents=True)
+        for i in range(10):
+            arr = (rng.random((48, 48, 3)) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.jpg", quality=90)
+    return str(root)
+
+
+def _serve(dataset, **kw):
+    from lance_distributed_training_tpu.service import (
+        DataService,
+        ServeConfig,
+    )
+
+    return DataService(ServeConfig(
+        dataset_path=dataset.uri, host="127.0.0.1", port=0,
+        image_size=32, queue_depth=2, **kw,
+    )).start()
+
+
+@pytest.mark.slow
+def test_remote_loader_bit_identical_with_server_side_scheduling(
+        sched_dataset):
+    from lance_distributed_training_tpu.service import RemoteLoader
+
+    def stream(svc):
+        loader = RemoteLoader(f"127.0.0.1:{svc.port}", 16, 0, 1,
+                              connect_retries=2, backoff_s=0.01)
+        return _digests(loader)
+
+    plain = _serve(sched_dataset, num_workers=2)
+    try:
+        ref = stream(plain)
+    finally:
+        plain.stop()
+    sched = _serve(sched_dataset, num_workers=2, sched_lookahead=6,
+                   sched_heavy_share=50)
+    try:
+        assert sched.scheduler is not None  # in-process DataService wiring
+        assert stream(sched) == ref
+    finally:
+        sched.stop()
+
+
+@pytest.mark.slow
+def test_fleet_loader_bit_identical_with_scheduling_members(sched_dataset):
+    from lance_distributed_training_tpu.data import ImageClassificationDecoder
+    from lance_distributed_training_tpu.data.pipeline import (
+        make_train_pipeline,
+    )
+    from lance_distributed_training_tpu.fleet import (
+        Coordinator,
+        CoordinatorConfig,
+        FleetLoader,
+    )
+
+    ref = _digests(make_train_pipeline(
+        sched_dataset, "batch", 16, 0, 1,
+        ImageClassificationDecoder(image_size=32),
+    ))
+    coord = Coordinator(CoordinatorConfig(host="127.0.0.1", port=0)).start()
+    servers = []
+    try:
+        for _ in range(2):
+            svc = _serve(sched_dataset, num_workers=2, sched_lookahead=6,
+                         coordinator_addr=f"127.0.0.1:{coord.port}")
+            assert svc.fleet_agent.registered.wait(5), "registration timed out"
+            servers.append(svc)
+        loader = FleetLoader(f"127.0.0.1:{coord.port}", 16, 0, 1,
+                             connect_retries=2, resolve_retries=3,
+                             backoff_s=0.05)
+        assert _digests(loader) == ref
+    finally:
+        for svc in servers:
+            svc.stop()
+        coord.stop()
+
+
+def test_remote_graph_refuses_client_side_schedule():
+    from lance_distributed_training_tpu.data.graph import (
+        Decode,
+        LanceSource,
+        LoaderGraph,
+        ServiceTransport,
+    )
+
+    with pytest.raises(ValueError, match="server-side"):
+        LoaderGraph(
+            LanceSource(None, "batch", 8, 0, 1),
+            Decode(task_type="image", image_size=32, schedule=SCHED),
+            ServiceTransport("h:1"),
+        )
