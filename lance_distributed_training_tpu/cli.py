@@ -14,6 +14,9 @@ The subcommands share the ``ldt`` entry point:
 * ``ldt coordinator …`` — the fleet control plane: membership, shard
   leases, heartbeats for N serve-data members; trainers point at it with
   ``--coordinator host:port`` (README "Fleet");
+* ``ldt jobs …`` — the job plane's operator view against a running
+  coordinator: per-job priority, sessions, resume cursor, cache hit
+  rate and SLO burn-down (README "Job plane");
 * ``ldt check …`` — the AST-based distributed-training lint (exits
   non-zero on new findings; see README "Static analysis");
 * ``ldt graph …`` — the cross-module concurrency model (spawned threads,
@@ -146,6 +149,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "at the resume cursor). Mutually exclusive with "
                         "--data_service; NOT the jax multi-host rendezvous "
                         "(--coordinator_address)")
+    p.add_argument("--job_id", type=str, default=None,
+                   help="declare this run's job on a shared data "
+                        "service/fleet (v6 job plane): per-job resume "
+                        "cursor, fairness weight and admission server-side. "
+                        "Needs --data_service or --coordinator; default = "
+                        "the implicit 'default' job")
+    p.add_argument("--job_priority", type=str, default=None,
+                   choices=["inference", "training", "bulk"],
+                   help="priority class for --job_id: inference = "
+                        "low-latency read-only probes that preempt bulk "
+                        "scans; training (default) and bulk share capacity "
+                        "by weighted-fair stride scheduling")
     p.add_argument("--no_ddp", action="store_true",
                    help="single-device debug mode (reference --no_ddp)")
     p.add_argument("--no_wandb", action="store_true")
@@ -384,6 +399,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "~/.cache/<pkg>/batch-cache)")
     p.add_argument("--queue_depth", type=int, default=4,
                    help="bounded per-client batch queue (backpressure)")
+    p.add_argument("--admission_max_jobs", type=int, default=0,
+                   help=">0: refuse a NEW job's first session once this "
+                        "many non-read-only jobs are admitted (diagnosable "
+                        "MSG_ERROR at HELLO; read-only/inference jobs and "
+                        "reconnects of admitted jobs always pass); 0 = "
+                        "unlimited (pre-r20 behavior)")
+    p.add_argument("--admission_max_stall_pct", type=float, default=0.0,
+                   help=">0: refuse a NEW job while this server's windowed "
+                        "decode stall is above this percentage — admitting "
+                        "another tenant would burn the existing jobs' "
+                        "stall SLO budget; 0 = no stall gate")
     p.add_argument("--handshake_timeout_s", type=float, default=30.0,
                    help="per-connection HELLO deadline; a peer that "
                         "connects and stays silent is dropped after this "
@@ -483,6 +509,86 @@ def build_fleet_parser() -> argparse.ArgumentParser:
     return p
 
 
+def build_jobs_parser() -> argparse.ArgumentParser:
+    """``ldt jobs`` — the job plane's operator view: every job the
+    coordinator's registry knows, aggregated across member heartbeats."""
+    p = argparse.ArgumentParser(
+        prog="ldt jobs",
+        description="Query a running `ldt coordinator` for the v6 job "
+                    "plane: per-job priority class, session count, resume "
+                    "cursor, cache hit rate and SLO burn-down",
+    )
+    p.add_argument("action", choices=["list", "describe"],
+                   help="list: one row per registered job; describe: full "
+                        "detail (per-objective burn windows) for one job")
+    p.add_argument("job_id", nargs="?", default=None,
+                   help="the job to describe (describe only)")
+    p.add_argument("--coordinator", type=str, required=True,
+                   metavar="HOST:PORT")
+    p.add_argument("--timeout_s", type=float, default=10.0)
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print the raw per-job rows as JSON (scripting)")
+    return p
+
+
+def _job_row_line(row: dict) -> str:
+    rate = row.get("cache_hit_rate")
+    return (
+        f"  {row.get('job_id')} [{row.get('priority')}] "
+        f"sessions {row.get('sessions', 0)} "
+        f"cursor {row.get('cursor', -1)} "
+        f"batches {row.get('batches_sent', 0)} "
+        f"cache_hit_rate {'-' if rate is None else rate}"
+    )
+
+
+def jobs_main(argv=None) -> int:
+    """``jobs`` subcommand body. Exit status: 0 on success, 4 when
+    ``describe`` names a job the registry does not know (scripting can
+    distinguish 'no such tenant' from transport failure)."""
+    import json
+
+    args = build_jobs_parser().parse_args(argv)
+    if args.action == "describe" and not args.job_id:
+        build_jobs_parser().error("describe needs a job_id")
+    from .fleet.balancer import resolve_fleet
+
+    payload = resolve_fleet(args.coordinator, timeout_s=args.timeout_s)
+    rows = payload.get("jobs") or []
+    if args.action == "describe":
+        rows = [r for r in rows if r.get("job_id") == args.job_id]
+        if not rows:
+            print(f"job {args.job_id!r} not registered with "
+                  f"{args.coordinator}")
+            return 4
+    if args.as_json:
+        print(json.dumps(rows, indent=2, sort_keys=True))
+        return 0
+    if args.action == "list":
+        print(f"{len(rows)} job(s), generation {payload.get('generation')}")
+        for row in rows:
+            print(_job_row_line(row))
+        return 0
+    row = rows[0]
+    print(f"job {row.get('job_id')}")
+    print(f"  priority:       {row.get('priority')}")
+    print(f"  sessions:       {row.get('sessions', 0)}")
+    print(f"  resume cursor:  {row.get('cursor', -1)}")
+    print(f"  batches sent:   {row.get('batches_sent', 0)}")
+    rate = row.get("cache_hit_rate")
+    print(f"  cache hit rate: {'-' if rate is None else rate} "
+          f"(hit {row.get('cache_hit', 0)} / "
+          f"miss {row.get('cache_miss', 0)})")
+    burn = row.get("slo_burn") or {}
+    for name in sorted(burn):
+        windows = burn[name]
+        line = " ".join(
+            f"{label}={windows[label]}" for label in sorted(windows)
+        )
+        print(f"  slo {name}: burn {line}")
+    return 0
+
+
 def fleet_main(argv=None) -> int:
     """``fleet`` subcommand body. Exit status encodes the recommendation
     for scripting: 0 = ok/drain_candidate, 3 = scale_up (so an operator
@@ -510,6 +616,21 @@ def fleet_main(argv=None) -> int:
                 f"clients {pressure.get('active_clients', '-')} "
                 f"(heartbeat {m.get('heartbeat_age_s')}s ago)"
             )
+        for entry in payload.get("stale_members", []) or []:
+            # Expired members whose last pressure window is retained (v6):
+            # evidence that went stale, not absent — the reason a drain
+            # recommendation may be withheld right after a blip.
+            pressure = entry.get("pressure") or {}
+            print(
+                f"  {entry.get('server_id')} EXPIRED "
+                f"{entry.get('stale_age_s')}s ago, last stall "
+                f"{pressure.get('stall_pct', '-')}%"
+            )
+        jobs = payload.get("jobs") or []
+        if jobs:
+            print(f"{len(jobs)} job(s):")
+            for row in jobs:
+                print(_job_row_line(row))
         queue_wait = payload.get("queue_wait_ms")
         if isinstance(queue_wait, dict):
             # Fleet-wide percentiles merged from the members' heartbeat
@@ -576,6 +697,8 @@ def serve_main(argv=None) -> dict:
         cache_disk_budget_mb=args.cache_disk_budget_mb,
         cache_dir=args.cache_dir,
         queue_depth=args.queue_depth,
+        admission_max_jobs=args.admission_max_jobs,
+        admission_max_stall_pct=args.admission_max_stall_pct,
         handshake_timeout_s=args.handshake_timeout_s,
         read_retries=args.read_retries,
         log_every_s=args.log_every_s,
@@ -620,6 +743,11 @@ def main(argv=None) -> dict:
         # Operator queries against a running coordinator (pressure table +
         # scale recommendation). Returns an int exit status: 3 = scale_up.
         return fleet_main(argv[1:])
+    if argv and argv[0] == "jobs":
+        # Job-plane queries against a running coordinator (per-job cursor,
+        # priority, sessions, cache hit rate, SLO burn). Returns an int
+        # exit status: 4 = describe target not registered.
+        return jobs_main(argv[1:])
     if argv and argv[0] == "check":
         # The static-analysis gate: returns an int exit status (0 = clean /
         # no new findings), not a metrics dict.
@@ -743,6 +871,8 @@ def main(argv=None) -> dict:
         pack_rows_multiple=args.pack_rows_multiple,
         data_service_addr=args.data_service,
         coordinator_addr=args.coordinator,
+        job_id=args.job_id,
+        job_priority=args.job_priority,
         no_ddp=args.no_ddp,
         no_wandb=args.no_wandb,
         model_name=args.model_name,

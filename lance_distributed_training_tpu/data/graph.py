@@ -508,14 +508,23 @@ class ServiceTransport(Transport):
     streams length-prefixed host batches. Network knobs
     (``connect_retries``/``backoff_s``/``timeout_s``/``registry``) pass
     through to :class:`~..service.client.RemoteLoader` verbatim, so its
-    defaults stay the single source of truth."""
+    defaults stay the single source of truth. ``job_id``/``job_priority``
+    (v6 job plane) declare this stream's tenancy — explicit so
+    ``describe()`` can show it; they fold into the same pass-through."""
 
-    def __init__(self, addr: str, **opts):
+    def __init__(self, addr: str, job_id: Optional[str] = None,
+                 job_priority: Optional[str] = None, **opts):
         self.addr = addr
+        if job_id is not None:
+            opts["job_id"] = job_id
+            if job_priority is not None:
+                opts["job_priority"] = job_priority
         self.opts = opts
 
     def detail(self) -> str:
-        return f"service addr={self.addr}"
+        job = self.opts.get("job_id")
+        suffix = f" job={job}" if job else ""
+        return f"service addr={self.addr}{suffix}"
 
 
 class FleetTransport(Transport):
@@ -527,12 +536,19 @@ class FleetTransport(Transport):
 
     tunable_names = ("stripe_width",)
 
-    def __init__(self, coordinator_addr: str, **opts):
+    def __init__(self, coordinator_addr: str, job_id: Optional[str] = None,
+                 job_priority: Optional[str] = None, **opts):
         self.coordinator_addr = coordinator_addr
+        if job_id is not None:
+            opts["job_id"] = job_id
+            if job_priority is not None:
+                opts["job_priority"] = job_priority
         self.opts = opts
 
     def detail(self) -> str:
-        return f"fleet coordinator={self.coordinator_addr}"
+        job = self.opts.get("job_id")
+        suffix = f" job={job}" if job else ""
+        return f"fleet coordinator={self.coordinator_addr}{suffix}"
 
 
 class DevicePut(Node):

@@ -21,6 +21,11 @@ PAPERS.md):
   ``RemoteLoader`` guarantees against one server.
 * :mod:`.chaos` — deterministic fault injection (scripted kill / stall /
   partition of member servers) so failover is *tested*, not asserted.
+* :mod:`.jobs` — the multi-tenant job plane (protocol v6):
+  :class:`JobPlane` on each server (per-job admission, weighted-fair
+  stride scheduling of produce capacity, per-job counters/cursors/SLO
+  burn) and :class:`JobRegistry` on the coordinator (fleet-wide per-job
+  rows aggregated from member heartbeats — ``ldt jobs``).
 
 Everything rides the existing length-prefixed frame protocol
 (:mod:`..service.protocol`); fleet metrics (``fleet_members``,
@@ -31,10 +36,24 @@ surfaces as the rest of the stack. See README "Fleet".
 
 from .balancer import FleetLoader  # noqa: F401
 from .coordinator import Coordinator, CoordinatorConfig, serve_coordinator  # noqa: F401
+from .jobs import (  # noqa: F401
+    AdmissionRefused,
+    FairScheduler,
+    JobPlane,
+    JobRegistry,
+    PriorityClass,
+    PRIORITY_CLASSES,
+)
 
 __all__ = [
+    "AdmissionRefused",
     "Coordinator",
     "CoordinatorConfig",
+    "FairScheduler",
     "FleetLoader",
+    "JobPlane",
+    "JobRegistry",
+    "PriorityClass",
+    "PRIORITY_CLASSES",
     "serve_coordinator",
 ]

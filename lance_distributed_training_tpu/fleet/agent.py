@@ -53,6 +53,11 @@ class FleetAgent:
         # heartbeat — the coordinator sums bucket counts across members
         # into fleet-wide percentiles. None (or a None return) omits the
         # field, so pre-v5 coordinators see the exact old payload.
+        jobs_fn: Optional[Callable[[], Optional[dict]]] = None,  # v6 job
+        # plane: this member's per-job stats (JobPlane.stats) per
+        # heartbeat, absorbed into the coordinator's JobRegistry. None
+        # (or a None/empty return) omits the field — pre-v6 coordinators
+        # and job-less members keep the exact old payload.
     ):
         self.coordinator_host, self.coordinator_port = P.parse_hostport(
             coordinator_addr
@@ -66,6 +71,7 @@ class FleetAgent:
         self.counters = counters
         self.pressure_fn = pressure_fn
         self.hist_fn = hist_fn
+        self.jobs_fn = jobs_fn
         self.heartbeat_interval_s = heartbeat_interval_s
         self.dial_timeout_s = dial_timeout_s
         self.backoff_s = backoff_s
@@ -149,6 +155,13 @@ class FleetAgent:
                 hist = self.hist_fn()
                 if hist is not None:
                     payload["queue_wait_hist"] = hist
+            except Exception:  # noqa: BLE001 — same contract as pressure
+                pass
+        if self.jobs_fn is not None:
+            try:
+                jobs = self.jobs_fn()
+                if jobs:  # None/empty → field omitted (old payload shape)
+                    payload["jobs"] = jobs
             except Exception:  # noqa: BLE001 — same contract as pressure
                 pass
         try:

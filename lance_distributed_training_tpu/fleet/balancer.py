@@ -54,15 +54,25 @@ from ..service import protocol as P
 __all__ = ["FleetLoader", "members_for_process", "resolve_fleet"]
 
 
-def resolve_fleet(coordinator_addr: str, timeout_s: float = 10.0) -> dict:
+def resolve_fleet(coordinator_addr: str, timeout_s: float = 10.0,
+                  job_id: Optional[str] = None,
+                  job_priority: Optional[str] = None) -> dict:
     """One RESOLVE round-trip: the coordinator's membership payload —
-    generation, stripe table, per-member heartbeat-reported pressure, and
-    the scale recommendation. Shared by :class:`FleetLoader` and
-    ``ldt fleet recommend`` (the operator's view of the same answer)."""
+    generation, stripe table, per-member heartbeat-reported pressure,
+    per-job registry rows, and the scale recommendation. Shared by
+    :class:`FleetLoader`, ``ldt fleet recommend`` and ``ldt jobs`` (the
+    operator's views of the same answer). ``job_id``/``job_priority``
+    ride the RESOLVE request (v6: they declare the caller's job to the
+    coordinator's registry; null = undeclared, and pre-v6 coordinators
+    ignore unknown fields, so the declaration is downgrade-safe by
+    construction)."""
     host, port = P.parse_hostport(coordinator_addr)
     timeout_s = min(float(timeout_s), 10.0)
     with socket.create_connection((host, port), timeout=timeout_s) as sock:
-        P.send_msg(sock, P.MSG_FLEET_RESOLVE, {})
+        P.send_msg(sock, P.MSG_FLEET_RESOLVE, {
+            "job_id": job_id,
+            "job_priority": job_priority,
+        })
         msg_type, reply = P.recv_msg(
             sock, deadline=time.monotonic() + timeout_s
         )
@@ -362,6 +372,8 @@ class FleetLoader:
         device_decode: Optional[bool] = None,
         token_pack: Optional[bool] = None,
         dataset_fingerprint: Optional[str] = None,
+        job_id: Optional[str] = None,
+        job_priority: Optional[str] = None,
         registry: Optional[MetricsRegistry] = None,
         buffer_pool=None,
         stripe_queue_depth: int = 2,
@@ -396,6 +408,14 @@ class FleetLoader:
         # the fleet must serve the SAME dataset content — a stale-mirror
         # member is rejected at its handshake, not silently striped in.
         self.dataset_fingerprint = dataset_fingerprint
+        # Job plane (v6): declared tenancy, carried on every member HELLO
+        # and on RESOLVE (the coordinator's registry learns the job even
+        # before any member admits it). An EXPLICIT job_id shares
+        # striping's no-downgrade rule — every member must speak
+        # JOB_MIN_VERSION (checked next to the stripe floor); None = the
+        # implicit default job, fine against any member.
+        self.job_id = job_id
+        self.job_priority = job_priority
         self.registry = registry if registry is not None else default_registry()
         self.counters = ServiceCounters(prefix="fleet", registry=self.registry)
         self.buffer_pool = buffer_pool
@@ -500,8 +520,11 @@ class FleetLoader:
         host = self.coordinator_host
         if ":" in host:
             host = f"[{host}]"
+        # Declare the job at resolve time: the registry row (priority,
+        # cursor) exists even while no member session is admitted yet.
         return resolve_fleet(
-            f"{host}:{self.coordinator_port}", timeout_s=self.timeout_s
+            f"{host}:{self.coordinator_port}", timeout_s=self.timeout_s,
+            job_id=self.job_id, job_priority=self.job_priority,
         )
 
     def _resolve_members(
@@ -587,6 +610,8 @@ class FleetLoader:
             device_decode=self.device_decode,
             token_pack=self.token_pack,
             dataset_fingerprint=self.dataset_fingerprint,
+            job_id=self.job_id,
+            job_priority=self.job_priority,
         )
 
     def _dial_member(self, addr: str, start_step: int, stripe_index: int,
@@ -651,6 +676,21 @@ class FleetLoader:
                             "support) — upgrade it or train with "
                             "--no_token_pack"
                         )
+                    # An explicit job shares the same no-downgrade rule: a
+                    # pre-v6 member would drop the job fields and stripe
+                    # this stream under the anonymous default tenant — no
+                    # per-job cursor, fairness or admission — while the
+                    # trainer believes its job_id took effect fleet-wide.
+                    if self.job_id is not None and int(
+                        reply.get("version", 0)
+                    ) < P.JOB_MIN_VERSION:
+                        raise P.ProtocolError(
+                            f"data server {addr} speaks protocol "
+                            f"{reply.get('version')} < "
+                            f"{P.JOB_MIN_VERSION} (no job plane) — "
+                            "upgrade it or drop the explicit job_id "
+                            f"{self.job_id!r}"
+                        )
                     # Stripe-echo check: the HELLO_OK carries back the
                     # residue class the server will actually serve. A
                     # server that accepted the handshake but mis-parsed,
@@ -678,6 +718,16 @@ class FleetLoader:
                             f"{echoed[0]!r}/{echoed[1]!r}, requested "
                             f"{stripe_index}/{stripe_count} — it would "
                             "serve the wrong residue class"
+                        )
+                    # Job-echo check (the RemoteLoader posture): a v6
+                    # member echoes the admitted job_id; disagreement
+                    # means this stripe was filed under another tenant.
+                    if self.job_id is not None and "job_id" in reply \
+                            and reply.get("job_id") != self.job_id:
+                        raise P.ProtocolError(
+                            f"data server {addr} echoed job_id "
+                            f"{reply.get('job_id')!r}, declared "
+                            f"{self.job_id!r} — tenancy desync"
                         )
                     self._num_steps = int(reply["num_steps"])  # ldt: ignore[LDT1002] -- idempotent plan-length cache: every writer stores the same value for a given epoch
                     sock.settimeout(None)  # streaming: no recv deadline

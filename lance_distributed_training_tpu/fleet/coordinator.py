@@ -62,6 +62,7 @@ from ..obs.registry import (
 )
 from ..obs.spans import span
 from ..service import protocol as P
+from .jobs import JobRegistry
 
 __all__ = ["CoordinatorConfig", "Coordinator", "serve_coordinator",
            "UNKNOWN_MEMBER_MARKER"]
@@ -95,6 +96,13 @@ class CoordinatorConfig:
     scale_down_stall_pct: float = 5.0  # every member below this (with >1
     # members and clients attached) makes the fleet a "drain_candidate"
     # (capacity to spare — an operator may drain one member)
+    stale_pressure_ttl_s: float = 0.0  # how long an EXPIRED member's last
+    # pressure window stays on the books (tagged stale) before the
+    # recommendation may trust the survivors alone; 0 = auto
+    # (5 × lease_ttl_s, floor 10s). A member that stalled hot and then
+    # blipped out must not flip the fleet to drain_candidate the moment
+    # its lease expires — scale-down on loss-of-evidence is the one
+    # direction a dropped heartbeat must never push.
 
 
 class _Member:
@@ -102,7 +110,7 @@ class _Member:
 
     __slots__ = ("server_id", "addr", "num_fragments", "last_heartbeat",
                  "stripe_index", "fragment_lo", "fragment_hi", "pressure",
-                 "acked_generation", "queue_wait_hist")
+                 "acked_generation", "queue_wait_hist", "jobs")
 
     def __init__(self, server_id: str, addr: str, num_fragments: int):
         self.server_id = server_id
@@ -124,6 +132,9 @@ class _Member:
         # "count"}, protocol v5) — None for pre-v5 members, exactly like
         # pressure. Bucket bounds are DEFAULT_MS_BUCKETS on both sides.
         self.queue_wait_hist: Optional[dict] = None
+        # Latest per-job stats this member reported (v6 job plane) —
+        # None for pre-v6 members, exactly like pressure.
+        self.jobs: Optional[dict] = None
 
     def lease(self, generation: int, stripe_count: int) -> dict:
         return {
@@ -145,6 +156,17 @@ class Coordinator:
         self._members: dict[str, _Member] = {}
         self._lock = threading.Lock()
         self.generation = 0
+        # Fleet-wide job view (v6): declared via RESOLVE payloads, fed by
+        # heartbeat `jobs` stats. Own (leaf) lock — safe to call under
+        # `_lock` (same acyclic shape as the registry gauges).
+        self.jobs = JobRegistry()
+        # Expired members' last pressure windows, tagged stale (guarded
+        # by `_lock`): server_id -> pressure dict + "expired_at"
+        # monotonic stamp. Retained for stale_pressure_ttl_s so a hot
+        # member's heartbeat blip cannot flip the recommendation to
+        # drain_candidate on loss of evidence; pruned by the expiry
+        # sweep, replaced by fresh evidence on re-register.
+        self._stale_pressure: dict[str, dict] = {}
         self._sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._expiry_thread: Optional[threading.Thread] = None
@@ -242,6 +264,24 @@ class Coordinator:
             ],
             "queue_wait_ms": self._queue_wait_merged_locked(),
             "recommendation": self._recommend_locked(),
+            # v6 job plane: fleet-wide per-job rows (additive key — old
+            # clients ignore it, like every RESOLVE extension).
+            "jobs": self.jobs.payload(),
+            # Expired members whose last pressure window is still on the
+            # books (see _expire_loop) — the evidence the recommendation
+            # refuses to scale down against.
+            "stale_members": [
+                {
+                    "server_id": sid,
+                    "pressure": {
+                        k: v for k, v in entry.items() if k != "expired_at"
+                    },
+                    "stale_age_s": round(
+                        now - entry.get("expired_at", now), 3
+                    ),
+                }
+                for sid, entry in sorted(self._stale_pressure.items())
+            ],
         }
 
     def _recommend_locked(self) -> dict:
@@ -288,6 +328,27 @@ class Coordinator:
             and serving
             and worst_stall <= cfg.scale_down_stall_pct
         ):
+            # Loss-of-evidence guard: an EXPIRED member whose last window
+            # was hotter than the drain band blocks drain_candidate while
+            # its stale pressure is retained. The survivors looking calm
+            # right after a hot member blipped out is exactly when the
+            # fleet must NOT shed capacity — expiry already shrank it.
+            stale_hot = sorted(
+                sid for sid, entry in self._stale_pressure.items()
+                if float(entry.get("stall_pct", 0.0))
+                > cfg.scale_down_stall_pct
+            )
+            if stale_hot:
+                return {
+                    "action": "ok", "code": 0,
+                    "stall_pct": worst_stall,
+                    "reason": (
+                        f"drain withheld: expired member(s) {stale_hot} "
+                        "last reported stall above "
+                        f"{cfg.scale_down_stall_pct:.1f}% — evidence "
+                        "stale, not absent"
+                    ),
+                }
             return {
                 "action": "drain_candidate", "code": -1,
                 "stall_pct": worst_stall,
@@ -319,6 +380,9 @@ class Coordinator:
                 self._members[server_id] = _Member(
                     server_id, addr, num_fragments
                 )
+                # Fresh member, fresh evidence: its live heartbeats
+                # supersede any stale window it left behind on expiry.
+                self._stale_pressure.pop(server_id, None)
                 self._rebalance_locked()
             member = self._members[server_id]
             reply = {
@@ -374,6 +438,12 @@ class Coordinator:
                 # malformed member degrades to "not reporting", never to a
                 # poisoned aggregate.
                 member.queue_wait_hist = dict(hist)
+            jobs = req.get("jobs")
+            if isinstance(jobs, dict):
+                # v6 job plane: stored as-reported (shape-guarded by the
+                # JobRegistry on absorption, same degrade-to-not-reporting
+                # posture as the histogram above).
+                member.jobs = dict(jobs)
             recommendation = self._recommend_locked()
             stalls = [
                 float(m.pressure.get("stall_pct", 0.0))
@@ -407,26 +477,49 @@ class Coordinator:
         self.registry.gauge("fleet_scale_recommendation").set(
             recommendation.get("code", 0)
         )
+        if isinstance(jobs, dict):
+            # Outside `_lock` (the JobRegistry lock is a leaf of its own).
+            self.jobs.observe_member(server_id, jobs)
         return P.MSG_FLEET_HEARTBEAT_OK, reply
 
     def _handle_deregister(self, req: dict) -> tuple:
         server_id = str(req.get("server_id") or "")
         with self._lock:
             if self._members.pop(server_id, None) is not None:
+                # A graceful leave is EVIDENCE, not a blip: no stale
+                # pressure retained (contrast _expire_loop).
+                self._stale_pressure.pop(server_id, None)
                 self._rebalance_locked()
             generation = self.generation
+        self.jobs.drop_member(server_id)
         self.registry.counter("fleet_deregistrations_total").inc()
         self._log(f"member {server_id} deregistered "
                   f"(generation {generation})")
         return P.MSG_FLEET_DEREGISTER_OK, {"generation": generation}
 
     def _handle_resolve(self, req: dict) -> tuple:
+        # v6 job plane: a resolving client may declare its job so the
+        # registry lists the tenant before any member has served it.
+        # Unknown/absent fields are simply ignored (a pre-v6 client's
+        # empty payload is the common case) — declare() validates types.
+        self.jobs.declare(req.get("job_id"), req.get("job_priority"))
         with self._lock:
             payload = self._members_payload_locked()
         self.registry.counter("fleet_resolves_total").inc()
         return P.MSG_FLEET_RESOLVE_OK, payload
 
     # -- expiry -------------------------------------------------------------
+
+    def _stale_pressure_ttl(self) -> float:
+        """Retention horizon for an expired member's last pressure window
+        (``stale_pressure_ttl_s``; 0 = 5 heartbeat-expiry TTLs, floor
+        10s — long enough for an operator or autoscaler poll cycle to
+        see the withheld-drain reason, short enough that a genuinely
+        departed member stops haunting the recommendation)."""
+        cfg = self.config
+        if cfg.stale_pressure_ttl_s > 0:
+            return float(cfg.stale_pressure_ttl_s)
+        return max(5.0 * cfg.lease_ttl_s, 10.0)
 
     def _expire_loop(self) -> None:
         ttl = self.config.lease_ttl_s
@@ -438,10 +531,28 @@ class Coordinator:
                 for server_id, m in list(self._members.items()):
                     if now - m.last_heartbeat > ttl:
                         expired.append(server_id)
+                        # Retain the last pressure window, tagged stale,
+                        # before the member record dies: expiry used to
+                        # drop it silently, and the survivors' calm would
+                        # flip the recommendation to drain_candidate on
+                        # the very blip that just shrank the fleet (the
+                        # _recommend_locked loss-of-evidence guard).
+                        if isinstance(m.pressure, dict):
+                            self._stale_pressure[server_id] = dict(
+                                m.pressure, stale=True, expired_at=now
+                            )
                         del self._members[server_id]
+                retention = self._stale_pressure_ttl()
+                for server_id in [
+                    sid for sid, entry in self._stale_pressure.items()
+                    if now - entry.get("expired_at", now) > retention
+                ]:
+                    del self._stale_pressure[server_id]
                 if expired:
                     self._rebalance_locked()
                     generation = self.generation
+            for server_id in expired:
+                self.jobs.drop_member(server_id)
             if expired:
                 self.registry.counter("fleet_expirations_total").inc(
                     len(expired)

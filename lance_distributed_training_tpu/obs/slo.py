@@ -47,6 +47,7 @@ __all__ = [
     "SLO",
     "DEFAULT_SLOS",
     "parse_slos",
+    "scoped_slos",
     "SLOTracker",
 ]
 
@@ -100,6 +101,28 @@ def parse_slos(spec: Optional[str]) -> Tuple[SLO, ...]:
             raise ValueError(f"SLO {part!r}: budget_pct must be in (0, 100]")
         out.append(SLO(name.strip(), float(rest), budget))
     return tuple(out) if out else DEFAULT_SLOS
+
+
+def scoped_slos(
+    scope: str, slos: Optional[Sequence[SLO]] = None
+) -> Tuple[SLO, ...]:
+    """The given objectives re-named under a scope prefix —
+    ``scoped_slos("job_tenant_a")`` turns ``stall_pct`` into
+    ``job_tenant_a_stall_pct`` with the threshold and budget unchanged.
+
+    This is how per-tenant burn-down rides the label-less registry
+    (``obs/registry.py`` deliberately has no label dimension — LDT601
+    name discipline instead): a scope IS a name prefix, so one
+    :class:`SLOTracker` per job publishes ``slo_job_<slug>_stall_pct``
+    and its burn windows next to the fleet-wide series. ``scope`` must
+    itself be metric-safe (``[a-z][a-z0-9_]*`` — callers sanitize via
+    ``fleet.jobs.job_slug``). ``slos=None`` scopes the ``LDT_SLOS``
+    env-var objectives, like :class:`SLOTracker` itself."""
+    if slos is None:
+        slos = parse_slos(os.environ.get("LDT_SLOS"))
+    return tuple(
+        SLO(f"{scope}_{s.name}", s.threshold, s.budget_pct) for s in slos
+    )
 
 
 class SLOTracker:

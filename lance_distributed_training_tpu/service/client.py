@@ -85,6 +85,8 @@ class RemoteLoader:
         device_decode: Optional[bool] = None,
         token_pack: Optional[bool] = None,
         dataset_fingerprint: Optional[str] = None,
+        job_id: Optional[str] = None,
+        job_priority: Optional[str] = None,
         registry: Optional[MetricsRegistry] = None,
         buffer_pool=None,
     ):
@@ -119,6 +121,13 @@ class RemoteLoader:
         # readable copy, when the trainer has one): the server rejects a
         # mismatched copy at connect time. None = undeclared, skipped.
         self.dataset_fingerprint = dataset_fingerprint
+        # Job plane (v6): declared tenancy. None = implicit default job —
+        # downgrade-safe (an old server simply has one tenant). An EXPLICIT
+        # job_id is NOT downgrade-safe: _dial_once refuses peers below
+        # JOB_MIN_VERSION instead of silently losing per-job cursors,
+        # fairness and admission (the token_pack precedent).
+        self.job_id = job_id
+        self.job_priority = job_priority
         self.registry = registry if registry is not None else default_registry()
         self.counters = ServiceCounters(registry=self.registry)
         # Buffer plane: received tensors are copied into recycled pool
@@ -233,6 +242,8 @@ class RemoteLoader:
             device_decode=self.device_decode,
             token_pack=self.token_pack,
             dataset_fingerprint=self.dataset_fingerprint,
+            job_id=self.job_id,
+            job_priority=self.job_priority,
         )
 
     def _connect(self, start_step: int, probe: bool = False,
@@ -337,6 +348,29 @@ class RemoteLoader:
                     f"data server speaks protocol {reply.get('version')} < "
                     f"{P.TOKEN_PACK_MIN_VERSION} (no token_pack support) — "
                     "upgrade it or train with --no_token_pack"
+                )
+            if self.job_id is not None and int(
+                reply.get("version", 0)
+            ) < P.JOB_MIN_VERSION:
+                # An explicitly declared job is not downgrade-safe: an
+                # older server drops the field and serves this client as
+                # the anonymous default tenant — no per-job cursor, no
+                # fairness weight, no admission gate — while the trainer
+                # believes its job_id took effect. Refuse loudly (the
+                # token_pack posture); an UNDECLARED job downgrades fine.
+                raise P.ProtocolError(
+                    f"data server speaks protocol {reply.get('version')} < "
+                    f"{P.JOB_MIN_VERSION} (no job plane) — upgrade it or "
+                    f"drop the explicit job_id {self.job_id!r}"
+                )
+            if self.job_id is not None and "job_id" in reply \
+                    and reply.get("job_id") != self.job_id:
+                # Echo check (LDT1401): a v6+ server echoes the admitted
+                # job_id; a disagreement means this session was filed
+                # under some other tenant's cursor/fairness scope.
+                raise P.ProtocolError(
+                    f"server echoed job_id {reply.get('job_id')!r}, "
+                    f"declared {self.job_id!r} — tenancy desync"
                 )
             # Cursor-echo check (LDT1401 closes the loop on every HELLO_OK
             # field): the server slices its plan at the echoed start_step —

@@ -404,8 +404,9 @@ GOLDEN_SPECS: List[GoldenSpec] = [
     # -- v5: causal tracing + fleet SLO histograms --------------------------
     GoldenSpec(
         "v5_hello_full", 5, "MSG_HELLO",
-        lambda: _frame(P.MSG_HELLO, _hello_current()),
-        note="the newest default HELLO (all fields, no features engaged)",
+        lambda: _frame(P.MSG_HELLO, _hello_current(version=5)),
+        note="the v5 HELLO (all fields, no features engaged) — pinned at "
+             "version=5 since v6 became the default offer",
     ),
     GoldenSpec(
         "v5_batch_trace", 5, "MSG_BATCH",
@@ -433,6 +434,34 @@ GOLDEN_SPECS: List[GoldenSpec] = [
         note="heartbeat carrying the v5 mergeable queue-wait histogram "
              "(bucket counts the coordinator sums into fleet-wide "
              "percentiles; pre-v5 coordinators ignore the key)",
+    ),
+    # -- v6: the multi-tenant job plane --------------------------------------
+    GoldenSpec(
+        "v6_hello_full", 6, "MSG_HELLO",
+        lambda: _frame(P.MSG_HELLO, _hello_current()),
+        note="the newest default HELLO: job keys present but null (the "
+             "implicit default tenant) — at v6+ the keys always ride, "
+             "below v6 they are omitted so v1-v5 frames stay "
+             "byte-identical",
+    ),
+    GoldenSpec(
+        "v6_hello_job", 6, "MSG_HELLO",
+        lambda: _frame(P.MSG_HELLO, _hello_current(
+            job_id="tenant-a", job_priority="inference",
+        )),
+        note="job-bearing HELLO: explicit tenancy + priority class "
+             "(admission-gated, weighted-fair scheduled, per-job cursor)",
+    ),
+    GoldenSpec(
+        "v6_error_admission_refused", 6, "MSG_ERROR",
+        lambda: _frame(P.MSG_ERROR, {
+            "message": "admission refused: job capacity reached (2/2 "
+                       "non-read-only jobs admitted); job 'tenant-c' "
+                       "must wait for a slot (--admission_max_jobs)",
+        }),
+        note="FROZEN wire prose — the ADMISSION_REFUSED_MARKER prefix is "
+             "what clients and operators key on to distinguish a refusal "
+             "from transport failure",
     ),
     GoldenSpec(
         "v3_fleet_register", 3, "MSG_FLEET_REGISTER",

@@ -73,6 +73,23 @@ field on the negotiated version (``TRACE_MIN_VERSION``) so pre-v5 peers
 receive byte-identical frames, an old decoder ignores the unknown meta
 key, and an old coordinator ignores the unknown heartbeat key — absence
 of either field is interop, never an error.
+
+Version 6 adds the **job plane** (:mod:`..fleet.jobs`): the HELLO's
+optional ``job_id`` / ``job_priority`` strings declare which logical
+tenant a session belongs to and its priority class, feeding the server's
+admission/fairness layer and the coordinator's job registry. Downgrade-
+SAFE, like lineage/trace: a v6 constructor emits the fields only for v6+
+HELLOs (pre-v6 frames stay byte-identical), and a server maps an absent
+``job_id`` — a v5 peer, or a v6 peer that declared nothing — onto the
+implicit default job, so every pre-r20 exchange keeps its exact behavior.
+A server MAY refuse a declared job at admission time (capacity or stall-
+SLO breach) with a MSG_ERROR whose message carries
+``ADMISSION_REFUSED_MARKER`` — frozen wire prose like the version-
+mismatch marker, so clients can distinguish "come back later" tenancy
+refusals from fatal handshake skew. Fleet RESOLVE payloads may likewise
+carry the job declaration (old coordinators ignore the unknown keys) and
+member heartbeats may carry a per-job ``jobs`` stats field (old
+coordinators ignore it — same contract as ``queue_wait_hist``).
 """
 
 from __future__ import annotations
@@ -94,11 +111,13 @@ __all__ = [
     "STRIPE_MIN_VERSION",
     "TOKEN_PACK_MIN_VERSION",
     "TRACE_MIN_VERSION",
+    "JOB_MIN_VERSION",
     "ragged_meta",
     "version_supported",
     "is_json_int",
     "hello_malformed",
     "VERSION_MISMATCH_MARKER",
+    "ADMISSION_REFUSED_MARKER",
     "MSG_HELLO",
     "MSG_HELLO_OK",
     "MSG_BATCH",
@@ -128,11 +147,12 @@ __all__ = [
     "ProtocolError",
 ]
 
-PROTOCOL_VERSION = 5
+PROTOCOL_VERSION = 6
 # Oldest peer version this build still speaks. v1 framing is a strict
 # subset of v2 (no lineage meta key), an unstriped v3 HELLO is a strict
-# subset of v2's, a pack-less v4 HELLO of v3's, and a v5 exchange differs
-# from v4 only by optional meta/heartbeat fields, so the floor stays 1.
+# subset of v2's, a pack-less v4 HELLO of v3's, a v5 exchange differs
+# from v4 only by optional meta/heartbeat fields, and a job-less v6
+# HELLO of v5's, so the floor stays 1.
 MIN_PROTOCOL_VERSION = 1
 # First version whose batch meta may carry the lineage field.
 LINEAGE_MIN_VERSION = 2
@@ -151,12 +171,26 @@ TOKEN_PACK_MIN_VERSION = 4
 # lineage: the sender simply omits the field for older peers (their
 # frames stay byte-identical) and a receiver treats absence as None.
 TRACE_MIN_VERSION = 5
+# First version whose HELLO may carry job_id / job_priority (the job
+# plane, fleet/jobs.py). Downgrade-SAFE for the default tenant, like
+# lineage/trace: the constructor omits the fields for older peers and a
+# server maps their absence onto the implicit default job. A client with
+# an EXPLICIT job declaration, however, must refuse older peers (they'd
+# ignore the unknown keys and serve the session untenanted — silent loss
+# of admission control and per-job accounting), never downgrade-retry.
+JOB_MIN_VERSION = 6
 # Error-message prefix every version rejection starts with — the marker the
 # client's downgrade retry keys on. FROZEN wire prose: deployed v1 servers
 # already say exactly "protocol version mismatch: server 1, client N", and
 # a v2 client must recognize THEIR rejection, so rewording this constant
 # (or a server's message) silently breaks new-client -> old-server interop.
 VERSION_MISMATCH_MARKER = "protocol version mismatch"
+# Error-message prefix every admission refusal starts with (v6 job
+# plane). FROZEN wire prose like the version marker: a client keys on it
+# to distinguish a retryable "fleet is full / SLO-protected" tenancy
+# refusal from fatal handshake skew, so rewording a deployed server's
+# message silently turns back-off retries into hard failures.
+ADMISSION_REFUSED_MARKER = "admission refused"
 
 
 def version_supported(version) -> bool:
@@ -197,6 +231,8 @@ _HELLO_FIELD_TYPES = (
     ("client_id", lambda v: isinstance(v, str), "string"),
     ("task_type", lambda v: isinstance(v, str), "string"),
     ("dataset_fingerprint", lambda v: isinstance(v, str), "string"),
+    ("job_id", lambda v: isinstance(v, str), "string"),
+    ("job_priority", lambda v: isinstance(v, str), "string"),
     ("shuffle", lambda v: isinstance(v, bool), "boolean"),
     ("probe", lambda v: isinstance(v, bool), "boolean"),
     ("device_decode", lambda v: isinstance(v, bool), "boolean"),
@@ -693,6 +729,8 @@ def hello(
     device_decode: Optional[bool] = None,
     token_pack: Optional[bool] = None,
     dataset_fingerprint: Optional[str] = None,
+    job_id: Optional[str] = None,
+    job_priority: Optional[str] = None,
     version: int = PROTOCOL_VERSION,
 ) -> dict:
     """Build the HELLO payload — the client's shard-of-the-plan request.
@@ -716,8 +754,15 @@ def hello(
     decode-config skew at connect time (a 224px server feeding a 299px
     trainer would otherwise train silently at the wrong resolution — global
     pooling accepts any spatial size).
+
+    ``job_id``/``job_priority`` (v6+) declare the logical tenant this
+    session belongs to and its priority class (fleet/jobs.py). Emitted
+    only when the offered version speaks the job plane, so every pre-v6
+    HELLO stays byte-identical to what a pre-r20 build produced; at v6
+    the keys are always present (null = the implicit default job), like
+    every other optional field above.
     """
-    return {
+    payload = {
         "version": int(version),
         "batch_size": int(batch_size),
         "process_index": int(process_index),
@@ -764,3 +809,14 @@ def hello(
             if dataset_fingerprint is not None else None
         ),
     }
+    # Job plane (v6+): gated on the OFFERED version, not merely appended —
+    # pre-v6 HELLOs must stay byte-identical (the golden corpus pins them)
+    # and a pre-v6 server must never see keys it would treat as unknown.
+    # The declared-job downgrade floor itself is enforced by the caller
+    # (client/balancer), which refuses pre-v6 peers when job_id is set.
+    if int(version) >= JOB_MIN_VERSION:
+        payload["job_id"] = str(job_id) if job_id is not None else None
+        payload["job_priority"] = (
+            str(job_priority) if job_priority is not None else None
+        )
+    return payload

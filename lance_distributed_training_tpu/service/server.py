@@ -50,6 +50,7 @@ from typing import Optional
 from ..data.cache import item_fingerprint
 from ..data.format import Dataset
 from ..data.graph import LanceSource
+from ..fleet.jobs import AdmissionRefused, JobPlane
 from ..obs.costs import cost_context, default_ledger
 from ..obs.lineage import make_lineage
 from ..obs.spans import span
@@ -144,6 +145,17 @@ class ServeConfig:
     # advertise_addr plus a random suffix (a restart is a new member)
     heartbeat_interval_s: float = 0.0  # 0 = use the coordinator-advertised
     # interval (CoordinatorConfig.heartbeat_interval_s)
+    admission_max_jobs: int = 0  # job-plane admission cap (fleet/jobs.py):
+    # at most this many non-read-only jobs admitted at once; a NEW job
+    # beyond the cap gets a diagnosable ADMISSION_REFUSED_MARKER
+    # MSG_ERROR. Read-only classes (inference probes) are exempt — the
+    # cap protects bulk decode capacity. 0 = unlimited (the pre-r20
+    # behavior: every tenant admitted).
+    admission_max_stall_pct: float = 0.0  # refuse NEW jobs while this
+    # server's windowed stall exceeds the ceiling — admitting another
+    # tenant into a decode plane already starving its clients would
+    # breach the stall SLO for every admitted job. Reconnects of
+    # already-admitted jobs always succeed. 0 = gate off.
 
 
 class _ClientSession:
@@ -158,6 +170,13 @@ class _ClientSession:
         self.last_acked = -1
         self.client_id = ""
         self.peer_version = P.PROTOCOL_VERSION  # refined by the HELLO
+        # Job-plane identity (v6): resolved from the HELLO during the
+        # handshake; pre-v6 peers (and undeclared v6 ones) land on the
+        # implicit default job. _admitted flips once the plane accepted
+        # the session, so close() releases exactly what admit() counted.
+        self.job_id = ""
+        self.job_priority = ""
+        self._admitted = False
         # Session decode hook: the padded decoder until the handshake
         # negotiates the ragged stream (v4 + token_pack HELLO).
         self.decode_fn = service.decode_fn_padded
@@ -216,7 +235,7 @@ class _ClientSession:
                 svc.counters.add("proto_malformed_hello")
                 P.send_msg(self.sock, P.MSG_ERROR, {"message": bad})
                 return
-            self.client_id = req.get("client_id", "")
+            self.client_id = req.get("client_id", "")  # ldt: ignore[LDT1002] -- set during the handshake, before _stream spawns the ack reader that reads it; happens-before
             skew = svc.decode_config_skew(req)
             if skew:
                 P.send_msg(self.sock, P.MSG_ERROR, {"message": skew})
@@ -232,7 +251,28 @@ class _ClientSession:
                 and bool(req.get("token_pack"))
             ):
                 self.decode_fn = svc.decode_fn  # ldt: ignore[LDT1002] -- set during the handshake, before _stream spawns the producer that reads it; happens-before
+            # Job plane (v6): resolve the declared tenant (absence → the
+            # implicit default job, which is every pre-v6 peer) and ask
+            # admission. A refusal is a diagnosable marker MSG_ERROR at
+            # connect time — the tenancy sibling of the skew rejections
+            # above, and the only gate that can say "come back later".
+            self.job_id, self.job_priority = JobPlane.resolve(  # ldt: ignore[LDT1002] -- set during the handshake, before _stream spawns the threads that read them; happens-before
+                req.get("job_id"), req.get("job_priority")
+            )
+            try:
+                svc.job_plane.admit(
+                    self.job_id, self.job_priority, self.peer
+                )
+            except AdmissionRefused as exc:
+                P.send_msg(self.sock, P.MSG_ERROR, {"message": str(exc)})
+                return
+            self._admitted = True  # ldt: ignore[LDT1002] -- handshake-phase write, read by close(); happens-before
             plan = svc.plan_for(req)
+            svc.job_plane.note_plan(self.job_id, (
+                req["sampler_type"], int(req["batch_size"]),
+                int(req["process_count"]), bool(req.get("shuffle")),
+                int(req.get("seed", 0)), int(req.get("epoch", 0)),
+            ))
             start = int(req.get("start_step", 0))
             if not 0 <= start <= len(plan):
                 P.send_msg(
@@ -275,18 +315,22 @@ class _ClientSession:
                 if s % stripe_count == stripe_index
             ]
             self.last_acked = start - 1  # ldt: ignore[LDT1002] -- initialized before _stream spawns the ack-reader; happens-before
-            P.send_msg(
-                self.sock, P.MSG_HELLO_OK,
-                # Echo the NEGOTIATED version, not this build's ceiling: a
-                # vN+1 server answering a vN client must echo vN (what the
-                # stream actually speaks), or the client's range check on
-                # the echo rejects a connection the server just accepted.
-                # num_steps is the FULL plan length — the stripe's share is
-                # the client's arithmetic (it owns the merge).
-                {"version": self.peer_version, "num_steps": len(plan),
-                 "start_step": start, "stripe_index": stripe_index,
-                 "stripe_count": stripe_count},
-            )
+            # Echo the NEGOTIATED version, not this build's ceiling: a
+            # vN+1 server answering a vN client must echo vN (what the
+            # stream actually speaks), or the client's range check on
+            # the echo rejects a connection the server just accepted.
+            # num_steps is the FULL plan length — the stripe's share is
+            # the client's arithmetic (it owns the merge).
+            reply = {"version": self.peer_version, "num_steps": len(plan),
+                     "start_step": start, "stripe_index": stripe_index,
+                     "stripe_count": stripe_count}
+            if "job_id" in req:
+                # Echo the RESOLVED job only to a peer that spoke the job
+                # vocabulary (a v6 HELLO always carries the key, null or
+                # not) — pre-v6 replies stay byte-identical, and a
+                # declaring client validates the echo like start_step.
+                reply["job_id"] = self.job_id
+            P.send_msg(self.sock, P.MSG_HELLO_OK, reply)
             if req.get("probe") or not steps:
                 # Metadata-only connect (len(loader)), or a cursor/stripe
                 # with nothing left to serve: confirm completion, no stream.
@@ -334,12 +378,20 @@ class _ClientSession:
             self.sock.close()
         except OSError:
             pass
+        if self._admitted:
+            # Idempotent (release() discards a set member): the session's
+            # slot leaves the tenant table, but the job itself — cursor,
+            # metric scope, priority class — survives for the reconnect.
+            self.service.job_plane.release(self.job_id, self.peer)
         self.service._forget(self)
 
     # -- streaming ---------------------------------------------------------
 
     def _stream(self, plan, steps, req: dict) -> None:
         svc = self.service
+        # Per-job metric scope (svc_job_<slug>_*): the tenant-resolved
+        # twin of the service-wide counters this loop already feeds.
+        jc = svc.job_plane.counters_for(self.job_id)
         producer = threading.Thread(
             target=self._produce, args=(plan, steps, req), daemon=True,
             name=f"ldt-svc-produce-{self.peer}",
@@ -360,12 +412,16 @@ class _ClientSession:
                     # would strand this thread (and its session) forever.
                     item = self._q.get(timeout=0.25)
                 except queue.Empty:
-                    svc.counters.add(
-                        "queue_empty_s", time.perf_counter() - t0
-                    )
+                    waited = time.perf_counter() - t0
+                    svc.counters.add("queue_empty_s", waited)
+                    if jc is not None:
+                        jc.add("queue_empty_s", waited)
                     continue
                 # Sender idle = decode is the bottleneck for this client.
-                svc.counters.add("queue_empty_s", time.perf_counter() - t0)
+                waited = time.perf_counter() - t0
+                svc.counters.add("queue_empty_s", waited)
+                if jc is not None:
+                    jc.add("queue_empty_s", waited)
                 if item is None:  # producer finished the plan
                     P.send_msg(self.sock, P.MSG_END, {})
                     return
@@ -417,6 +473,9 @@ class _ClientSession:
                     sent = P.send_batch_frame(self.sock, meta, views)
                 svc.counters.add("batches_sent")
                 svc.counters.add("bytes_sent", sent)
+                if jc is not None:
+                    jc.add("batches_sent")
+                    jc.add("bytes_sent", sent)
                 # Frame is on the wire: the views die with `item`, so the
                 # pooled decode pages can recycle into the next batch.
                 if svc.buffer_pool is not None:
@@ -493,6 +552,12 @@ class _ClientSession:
             for off, step in enumerate(steps):
                 if self._stop.is_set():
                     return
+                # Weighted-fair pacing across tenants (fleet/jobs.py):
+                # under contention the scheduler grants produce steps by
+                # priority-class weight, and preempting classes (inference
+                # single-batch fetches) go first. Capacity-only — bounded
+                # wait, plan order and batch bytes untouched (LDT1301).
+                svc.job_plane.begin_step(self.job_id)
                 item = items[off]
                 # Trace context is born HERE, with the plan item — every
                 # downstream hop (send, client merge, train step) descends
@@ -541,6 +606,11 @@ class _ClientSession:
                         ),
                     )
                 svc.counters.observe("decode_ms", decode_ms)
+                if cache is not None:
+                    # Per-job hit accounting: a second same-config tenant
+                    # streaming decode-free shows up as ITS hits, not an
+                    # anonymous cache aggregate.
+                    svc.job_plane.note_cache(self.job_id, cache_hit)
                 lineage = make_lineage(step, decode_ms)
                 # Zero-join serialisation: flat views over the batch's own
                 # buffers (tensor_views) ride the queue; the sender's
@@ -572,6 +642,13 @@ class _ClientSession:
                     )
                     self.service.counters.gauge(
                         "last_acked", self.last_acked
+                    )
+                    # Per-job resume cursor: the registry-visible answer
+                    # to "where was this tenant?" — an observed ACK, so
+                    # cursor COMPUTATION stays client-owned (LDT1301).
+                    self.service.job_plane.note_cursor(
+                        self.job_id, self.client_id or self.peer,
+                        self.last_acked,
                     )
                 elif msg_type == P.MSG_ERROR:
                     self.service._log(
@@ -711,6 +788,19 @@ class DataService:
         # to the heartbeat thread (single-caller contract above).
         self._slo = None
         self._slo_prev: tuple = ({}, time.monotonic())
+        # Admission-gate stall window: its OWN anchor (admit() calls are
+        # rare and must not shorten the pressure/SLO windows above).
+        self._admission_prev: tuple = ({}, time.monotonic())
+        # Job plane (fleet/jobs.py): tenant table + fairness + admission.
+        # With both knobs at their 0 defaults every session is admitted
+        # onto the implicit default job — the exact pre-r20 behavior.
+        self.job_plane = JobPlane(
+            counters=self.counters,
+            registry=self.counters.registry,
+            max_jobs=config.admission_max_jobs,
+            max_stall_pct=config.admission_max_stall_pct,
+            stall_fn=self._admission_stall_pct,
+        )
 
     def pressure(self) -> dict:
         """Windowed pressure since the previous call — what this member
@@ -785,6 +875,30 @@ class DataService:
         if hist is None:
             return float("nan")  # no traffic yet: probe skipped
         return hist.percentile(99)
+
+    def _admission_stall_pct(self) -> float:
+        """Stall share since the previous ADMISSION check (own anchor —
+        single caller is JobPlane.admit under its lock). Long windows
+        between arrivals only smooth the signal."""
+        now = time.monotonic()
+        snap = self.counters.snapshot()
+        prev, prev_t = self._admission_prev
+        self._admission_prev = (snap, now)
+        window_s = max(now - prev_t, 1e-6)
+        with self._sessions_lock:
+            active = len(self._sessions)
+        if not active:
+            return 0.0
+        d = (snap.get("svc_queue_empty_s", 0.0)
+             - prev.get("svc_queue_empty_s", 0.0))
+        return min(100.0, 100.0 * d / (window_s * active))
+
+    def job_stats(self) -> Optional[dict]:
+        """Per-job stats for fleet heartbeats (the optional ``jobs``
+        field — omitted while no tenant is admitted, so heartbeats to an
+        old coordinator stay byte-identical until the plane is used)."""
+        stats = self.job_plane.stats()
+        return stats or None
 
     # -- data plane --------------------------------------------------------
 
@@ -1042,6 +1156,10 @@ class DataService:
                 # bucket counts, aggregated coordinator-side into
                 # fleet_queue_wait_p{50,95,99}_ms.
                 hist_fn=self.queue_wait_hist,
+                # v6 job plane: per-job stats ride heartbeats into the
+                # coordinator's JobRegistry (old coordinators ignore the
+                # unknown field, like hist_fn's).
+                jobs_fn=self.job_stats,
             ).start()
             self._log(
                 f"fleet member {self.fleet_agent.server_id} -> "
@@ -1116,11 +1234,16 @@ class DataService:
             "active_clients": len(sessions),
             "stopped": stopped,
             "fleet": fleet,
+            # Per-tenant view (fleet/jobs.py): sessions, cursor, cache
+            # hits, SLO burn per admitted job — {} until a v6 session (or
+            # any default-job session) lands.
+            "jobs": self.job_plane.stats(),
             "sessions": [
                 {
                     "peer": s.peer,
                     "client_id": s.client_id,
                     "protocol_version": s.peer_version,
+                    "job_id": s.job_id,
                     "last_acked": s.last_acked,
                     "queue_depth": s._q.qsize(),
                 }
@@ -1216,6 +1339,9 @@ class DataService:
             # Releases the RAM ring's pool leases; the disk tier stays
             # (it is the restart-warm path).
             self.batch_cache.close()
+        # Last: per-job SLO tickers are daemon threads reading counters
+        # the sessions above were still feeding.
+        self.job_plane.stop()
 
     def __enter__(self) -> "DataService":
         return self.start() if self._sock is None else self

@@ -160,6 +160,12 @@ class TrainConfig:
     # server loss — same bit-identical batch contract, elastic capacity.
     # Mutually exclusive with data_service_addr; NOT the jax multi-host
     # rendezvous (that is coordinator_address, below).
+    job_id: Optional[str] = None  # v6 job plane: this run's tenancy on a
+    # shared DataService/fleet — per-job resume cursor, fairness weight and
+    # admission on the server side. None = the implicit "default" job
+    # (downgrade-safe against pre-v6 servers; an explicit id refuses them).
+    job_priority: Optional[str] = None  # priority class for job_id
+    # ("inference" | "training" | "bulk"); None = server default (training).
     no_ddp: bool = False  # single-device escape hatch (lance_iterable.py:145)
     no_wandb: bool = False  # lance_iterable.py:146
     model_name: Optional[str] = None  # default per task (resnet50 / bert_base / clip)
@@ -806,9 +812,13 @@ def _build_loader(config: TrainConfig, dataset, mesh, epoch: int = 0,
             token_pack=config.token_pack,
         )
         transport = (
-            FleetTransport(config.coordinator_addr)
+            FleetTransport(config.coordinator_addr,
+                           job_id=config.job_id,
+                           job_priority=config.job_priority)
             if config.coordinator_addr
-            else ServiceTransport(config.data_service_addr)
+            else ServiceTransport(config.data_service_addr,
+                                  job_id=config.job_id,
+                                  job_priority=config.job_priority)
         )
         loader = _assemble(source, decode_node,
                            Prefetch(config.prefetch), transport)
@@ -1090,6 +1100,19 @@ def train(config: TrainConfig) -> dict:
         raise ValueError(
             "data_service_addr and coordinator_addr are mutually exclusive "
             "(one names a single server, the other a fleet's coordinator)"
+        )
+    if config.job_id and not (
+        config.data_service_addr or config.coordinator_addr
+    ):
+        raise ValueError(
+            "job_id declares tenancy on a shared data service/fleet — it "
+            "needs data_service_addr or coordinator_addr (local decode has "
+            "no job plane)"
+        )
+    if config.job_priority and not config.job_id:
+        raise ValueError(
+            "job_priority needs an explicit job_id (the implicit default "
+            "job always runs at the server's default class)"
         )
     if config.fsdp and config.zero_opt:
         raise ValueError(

@@ -143,6 +143,15 @@ def derive_window(delta: Dict[str, float]) -> Dict[str, float]:
     ring = delta.get("placement_buffer_depth")
     if ring is not None:
         w["ring_occupancy"] = ring
+    jobs_active = delta.get("svc_jobs_active")
+    if jobs_active is not None:
+        # Job plane (r20): how many tenants share this data plane right
+        # now. Present only on a process that hosts a DataService (the
+        # gauge is server-side) — lets the policy distinguish "my stall
+        # is my own" from "capacity is deliberately shared N ways", where
+        # shrinking a knob would hand the freed capacity to OTHER jobs
+        # rather than prove it unneeded.
+        w["jobs_active"] = jobs_active
     return w
 
 

@@ -67,6 +67,11 @@ BOTTLENECK_CODES = {
     # grow the scheduler's dispatch-reorder lookahead before throwing
     # uniform capacity at a non-uniform problem.
     "straggler_bound": 9,
+    # Multi-tenant data plane (r20): calm window, but >1 jobs share this
+    # server's produce capacity — shrink is withheld (the fair scheduler
+    # would hand the freed capacity to other jobs, so calm proves no
+    # headroom of our own).
+    "multi_tenant_hold": 10,
 }
 
 # Capacity ladder for decode/transport-bound growth, in expected-payoff
@@ -331,6 +336,17 @@ class HillClimbPolicy:
                     "pad_waste_bound", stall, knobs,
                 )
         if stall <= c.stall_lo_pct:
+            if window.get("jobs_active", 0) > 1:
+                # Multi-tenant data plane (r20): this process looks calm,
+                # but the capacity a shrink would "give back" is shared —
+                # the fair scheduler hands it to the OTHER jobs, so a calm
+                # window proves nothing about this job's own headroom.
+                # Hold every knob instead of ratcheting down (windows with
+                # no jobs_active signal — no DataService in-process —
+                # keep the exact pre-r20 shrink behavior).
+                self._calm = 0
+                self.last_bottleneck = "multi_tenant_hold"
+                return []
             self._calm += 1
             if self._calm >= c.shrink_patience:
                 self._calm = 0

@@ -70,6 +70,18 @@
 # plan-order control arm (reordered dispatch is capacity, never
 # content), and zero leaked leases / shm ring slots under
 # LDT_LEAK_SANITIZER=1 despite out-of-order result holding.
+# Stage 7g — jobs smoke (scripts/jobs_smoke.py): the r20 multi-tenant
+# plane over real subprocesses — coordinator + 2 serve-data members
+# (--batch_cache --admission_max_jobs 1) + two real `ldt train
+# --coordinator --job_id` runs (one training-class, one inference-class
+# probe riding the read_only exemption). Both runs must exit 0, a third
+# non-read-only HELLO must be refused with the frozen "admission
+# refused" marker, per-job svc_job_<slug>_* / slo_job_<slug>_* scopes
+# plus svc_jobs_active / svc_admission_refusals must be live on a
+# member /metrics, the inference tenant must stream cross-job cache
+# hits off the training run's content keys, `ldt jobs list/describe`
+# must show both tenants against the live coordinator, and /dev/shm
+# must end clean under LDT_LEAK_SANITIZER=1.
 # Stage 8 — the tier-1 verify command from ROADMAP.md, verbatim — run
 # under LDT_LOCK_SANITIZER=1, LDT_LEAK_SANITIZER=1, LDT_WIRE_SANITIZER=1
 # AND LDT_COMPILE_SANITIZER=1: every threading.Lock/RLock the package
@@ -235,6 +247,14 @@ echo "== straggler smoke (reordered dispatch, digest parity, leak-clean) =="
 # bit-identical to plan order, and the out-of-order result holding must
 # release every ring slot (leak sanitizer on).
 timeout -k 10 300 env JAX_PLATFORMS=cpu LDT_LEAK_SANITIZER=1 PYTHONPATH=. python scripts/straggler_smoke.py
+
+echo "== jobs smoke (multi-tenant fleet: admission, fairness, per-job metrics) =="
+# Real tenants on real subprocesses: two `ldt train --job_id` runs share
+# one 2-member fleet under --admission_max_jobs 1 (the inference probe
+# rides the read_only exemption), a third tenant is refused on the live
+# wire, per-job metric scopes + cross-job cache hits are asserted on a
+# live member /metrics, and `ldt jobs` reads the coordinator registry.
+timeout -k 10 540 env JAX_PLATFORMS=cpu LDT_LEAK_SANITIZER=1 PYTHONPATH=. python scripts/jobs_smoke.py
 
 echo "== protocol goldens (cross-version byte-identity gate) =="
 # Every checked-in frame blob decodes with the current build and

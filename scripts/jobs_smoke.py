@@ -1,0 +1,272 @@
+"""CI job-plane smoke: coordinator + 2 real `ldt serve-data` members
+(--batch_cache, --admission_max_jobs 1) + two real `ldt train
+--coordinator --job_id` runs — one training-class, one inference-class
+probe — then an admission refusal on the live wire.
+
+Asserts the r20 multi-tenant plane end-to-end, on real subprocess
+artifacts:
+
+1. both `ldt train` runs exit 0 while sharing one fleet (the fair
+   scheduler paces, never wedges);
+2. per-job metric scopes are LIVE on a member /metrics scrape:
+   ``svc_job_smoke_train_*`` and ``svc_job_smoke_probe_*`` series,
+   ``svc_jobs_active``, and the per-job ``slo_job_<slug>_*`` burn-down
+   gauges published by the per-job SLO tracker;
+3. the second same-config tenant streams CROSS-JOB cache hits
+   (``svc_job_smoke_probe_cache_hit > 0`` summed over members) — the
+   PR-13 content keys are job-agnostic by construction;
+4. a third non-read-only job is refused admission with the frozen
+   ``admission refused`` marker prose (``--admission_max_jobs 1``; the
+   inference probe was exempt as read_only) and the refusal is counted
+   on /metrics;
+5. `ldt jobs list` / `describe` against the live coordinator show both
+   tenants with their priority classes and a real resume cursor;
+6. zero /dev/shm segments outlive the run (LDT_LEAK_SANITIZER=1 in CI).
+
+Equivalent by hand:
+    ldt coordinator --port 8470 &
+    ldt serve-data --coordinator 127.0.0.1:8470 --batch_cache \
+        --admission_max_jobs 1 …  &   # x2
+    ldt train --coordinator 127.0.0.1:8470 --job_id smoke-train \
+        --job_priority training …
+    ldt train --coordinator 127.0.0.1:8470 --job_id smoke-probe \
+        --job_priority inference …
+    ldt jobs list --coordinator 127.0.0.1:8470
+
+Run as a real script (spawned decode workers re-import __main__):
+    PYTHONPATH=. python scripts/jobs_smoke.py
+"""
+
+import io
+import os
+import pathlib
+import re
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+from PIL import Image
+
+TRAIN_TIMEOUT_S = 240
+
+
+def scrape(port: int) -> str:
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ).read().decode()
+
+
+def series_total(text: str, name: str) -> float:
+    """Sum every sample of one Prometheus series in a scrape."""
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(f"{name} "):
+            total += float(line.split()[-1])
+    return total
+
+
+def metrics_port_from_log(log: pathlib.Path, deadline: float) -> int:
+    while time.monotonic() < deadline:
+        for line in log.read_text(errors="replace").splitlines():
+            if "metrics on :" in line:
+                return int(line.split("metrics on :")[1].split(" ")[0])
+        time.sleep(0.2)
+    raise SystemExit(f"{log} never logged its metrics port")
+
+
+def main() -> None:
+    from lance_distributed_training_tpu.fleet import (
+        Coordinator,
+        CoordinatorConfig,
+    )
+    from lance_distributed_training_tpu.data import write_dataset
+    from lance_distributed_training_tpu.service import protocol as P
+
+    shm_before = set(os.listdir("/dev/shm")) if os.path.isdir(
+        "/dev/shm"
+    ) else set()
+    rng = np.random.default_rng(0)
+
+    def jpeg() -> bytes:
+        arr = (rng.random((32, 32, 3)) * 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG")
+        return buf.getvalue()
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="ldt-ci-jobs-"))
+    procs: list = []
+    coord = None
+    try:
+        table = pa.table({
+            "image": pa.array([jpeg() for _ in range(240)], pa.binary()),
+            "label": pa.array(rng.integers(0, 10, 240), pa.int64()),
+        })
+        ds = write_dataset(table, tmp / "ds", mode="create",
+                           max_rows_per_file=60)
+
+        coord = Coordinator(CoordinatorConfig(
+            host="127.0.0.1", port=0, heartbeat_interval_s=0.25,
+            lease_ttl_s=5.0, metrics_port=0,
+        )).start()
+        caddr = f"127.0.0.1:{coord.port}"
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=os.getcwd())
+        srv_logs = [tmp / "srv0.out", tmp / "srv1.out"]
+        for i in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "lance_distributed_training_tpu.cli",
+                 "serve-data", "--dataset_path", str(ds.uri),
+                 "--host", "127.0.0.1", "--port", "0", "--image_size", "32",
+                 "--queue_depth", "2", "--coordinator", caddr,
+                 "--batch_cache", "--admission_max_jobs", "1",
+                 "--metrics_port", "0", "--log_every_s", "0"],
+                env=env, stdout=open(srv_logs[i], "wb"),
+                stderr=subprocess.STDOUT,
+            ))
+
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if coord._healthz()["stripe_count"] == 2:
+                break
+            for p in procs:
+                if p.poll() is not None:
+                    raise SystemExit(
+                        f"serve-data exited early: {p.returncode}"
+                    )
+            time.sleep(0.2)
+        else:
+            raise SystemExit("members never registered")
+        print("[smoke] 2 members registered (admission cap 1, batch cache)")
+
+        # Two real tenants, one fleet. Same decode config on purpose: the
+        # second (inference) run must stream CROSS-job cache hits off the
+        # batches the first run decoded — content keys know no tenants.
+        def run_train(job_id: str, priority: str) -> None:
+            run = subprocess.run(
+                [sys.executable, "-m",
+                 "lance_distributed_training_tpu.cli", "train",
+                 "--dataset_path", str(ds.uri), "--coordinator", caddr,
+                 "--job_id", job_id, "--job_priority", priority,
+                 "--num_classes", "10", "--model_name", "resnet18",
+                 "--image_size", "32", "--batch_size", "16",
+                 "--epochs", "1", "--lr", "0.01", "--seed", "7",
+                 "--no_wandb", "--no_augment", "--no_eval_at_end",
+                 "--no_autotune", "--log_every", "0"],
+                env=env, timeout=TRAIN_TIMEOUT_S,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            )
+            if run.returncode != 0:
+                print(run.stdout.decode(errors="replace")[-4000:])
+                raise SystemExit(
+                    f"train {job_id} exited rc={run.returncode}"
+                )
+            print(f"[smoke] train run {job_id} [{priority}] done (rc=0)")
+
+        run_train("smoke-train", "training")
+        run_train("smoke-probe", "inference")
+
+        # A THIRD non-read-only tenant must be refused: smoke-train holds
+        # the single --admission_max_jobs slot (admitted jobs outlive
+        # their sessions), and smoke-probe rode the read_only exemption.
+        member_addr = coord._healthz()["members"][0]["addr"]
+        host, port = P.parse_hostport(member_addr)
+        sock = socket.create_connection((host, port), timeout=10)
+        try:
+            P.send_msg(sock, P.MSG_HELLO, P.hello(
+                batch_size=16, process_index=0, process_count=1,
+                job_id="smoke-extra", job_priority="training",
+            ))
+            msg_type, reply = P.recv_msg(sock)
+        finally:
+            sock.close()
+        assert msg_type == P.MSG_ERROR, (msg_type, reply)
+        message = reply.get("message", "")
+        assert message.startswith(P.ADMISSION_REFUSED_MARKER), reply
+        assert "job capacity reached" in message, reply
+        print(f"[smoke] third tenant refused: {message!r}")
+
+        # Per-job scopes + refusal counter + per-job SLO burn-down on a
+        # LIVE member /metrics scrape (the per-job SLO ticker runs at 5s,
+        # so poll for its first publication).
+        deadline = time.monotonic() + 90
+        mports = [metrics_port_from_log(log, deadline) for log in srv_logs]
+        wanted = ("svc_job_smoke_train_batches_sent",
+                  "svc_job_smoke_probe_batches_sent",
+                  "svc_jobs_active", "svc_admission_refusals",
+                  "slo_job_smoke_train_stall_pct")
+        texts = []
+        while time.monotonic() < deadline:
+            texts = [scrape(p) for p in mports]
+            if all(any(s in t for t in texts) for s in wanted):
+                break
+            time.sleep(0.5)
+        for s in wanted:
+            assert any(s in t for t in texts), f"missing {s} in /metrics"
+        assert sum(
+            series_total(t, "svc_admission_refusals") for t in texts
+        ) >= 1.0
+        probe_hits = sum(
+            series_total(t, "svc_job_smoke_probe_cache_hit") for t in texts
+        )
+        assert probe_hits > 0, "inference tenant streamed no cache hits"
+        print(f"[smoke] per-job scopes + slo burn live on /metrics; "
+              f"cross-job cache hits: {probe_hits:.0f}")
+
+        # The operator CLI against the live coordinator.
+        jobs_list = subprocess.run(
+            [sys.executable, "-m", "lance_distributed_training_tpu.cli",
+             "jobs", "list", "--coordinator", caddr],
+            env=env, timeout=60, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        out = jobs_list.stdout.decode(errors="replace")
+        assert jobs_list.returncode == 0, out
+        assert "smoke-train [training]" in out, out
+        assert "smoke-probe [inference]" in out, out
+        describe = subprocess.run(
+            [sys.executable, "-m", "lance_distributed_training_tpu.cli",
+             "jobs", "describe", "smoke-train", "--coordinator", caddr],
+            env=env, timeout=60, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        dout = describe.stdout.decode(errors="replace")
+        assert describe.returncode == 0, dout
+        cursor = re.search(r"resume cursor:\s+(-?\d+)", dout)
+        assert cursor and int(cursor.group(1)) >= 0, dout
+        print(f"[smoke] ldt jobs list/describe ok "
+              f"(smoke-train cursor {cursor.group(1)})")
+
+        # SIGTERM drain stays clean with the job plane attached.
+        procs[0].terminate()
+        assert procs[0].wait(timeout=60) == 0, procs[0].returncode
+        print("[smoke] member drained cleanly on SIGTERM (exit 0)")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout=30)
+        if coord is not None:
+            coord.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    shm_after = set(os.listdir("/dev/shm")) if os.path.isdir(
+        "/dev/shm"
+    ) else set()
+    leaked = shm_after - shm_before
+    assert not leaked, f"leaked /dev/shm segments: {sorted(leaked)}"
+    print("[smoke] jobs smoke ok: two tenants, fair shared fleet, "
+          "admission refusal, cross-job cache hits, no shm leaks")
+
+
+if __name__ == "__main__":
+    main()

@@ -158,6 +158,70 @@ def test_cli_zero_levels_and_device_decode(monkeypatch):
                   "--no_device_decode"])
 
 
+def test_cli_job_plane_flags(monkeypatch):
+    """The r20 tenancy knobs reach TrainConfig; defaults stay None (the
+    implicit default job, downgrade-safe)."""
+    captured = {}
+    monkeypatch.setattr(
+        cli, "train", lambda config: captured.update(config=config) or {}
+    )
+    cli.main([
+        "--dataset_path", "/d", "--no_wandb",
+        "--coordinator", "127.0.0.1:8470",
+        "--job_id", "tenant-a", "--job_priority", "inference",
+    ])
+    config = captured["config"]
+    assert config.job_id == "tenant-a"
+    assert config.job_priority == "inference"
+    cli.main(["--dataset_path", "/d", "--no_wandb"])
+    assert captured["config"].job_id is None
+    assert captured["config"].job_priority is None
+    # Unknown priority classes are a parse error, not a server refusal.
+    with pytest.raises(SystemExit):
+        cli.main(["--dataset_path", "/d", "--no_wandb",
+                  "--job_id", "t", "--job_priority", "urgent"])
+
+
+def test_train_config_job_validation():
+    """job_id needs a remote data plane; job_priority needs a job_id —
+    both fail before any dataset I/O."""
+    from lance_distributed_training_tpu.trainer import TrainConfig, train
+
+    with pytest.raises(ValueError, match="job_id declares tenancy"):
+        train(TrainConfig(dataset_path="/d", job_id="tenant-a"))
+    with pytest.raises(ValueError, match="job_priority needs"):
+        train(TrainConfig(dataset_path="/d",
+                          coordinator_addr="127.0.0.1:8470",
+                          job_priority="bulk"))
+
+
+def test_serve_parser_admission_flags():
+    args = cli.build_serve_parser().parse_args([
+        "--dataset_path", "/d",
+        "--admission_max_jobs", "2", "--admission_max_stall_pct", "35",
+    ])
+    assert args.admission_max_jobs == 2
+    assert args.admission_max_stall_pct == 35.0
+    defaults = cli.build_serve_parser().parse_args(["--dataset_path", "/d"])
+    assert defaults.admission_max_jobs == 0  # gate off = pre-r20 behavior
+    assert defaults.admission_max_stall_pct == 0.0
+
+
+def test_jobs_parser_round_trip():
+    args = cli.build_jobs_parser().parse_args([
+        "describe", "tenant-a", "--coordinator", "127.0.0.1:8470",
+        "--timeout_s", "3", "--json",
+    ])
+    assert args.action == "describe" and args.job_id == "tenant-a"
+    assert args.timeout_s == 3.0 and args.as_json is True
+    args = cli.build_jobs_parser().parse_args(
+        ["list", "--coordinator", "127.0.0.1:8470"]
+    )
+    assert args.action == "list" and args.job_id is None
+    with pytest.raises(SystemExit):  # --coordinator is required
+        cli.build_jobs_parser().parse_args(["list"])
+
+
 def test_serve_parser_device_decode():
     args = cli.build_serve_parser().parse_args(
         ["--dataset_path", "/d", "--device_decode"]

@@ -1,0 +1,772 @@
+"""Multi-tenant job plane (protocol v6): fair scheduling, admission,
+per-job cursors/metrics on the DataService, the coordinator's JobRegistry
+aggregate, and the `ldt jobs` operator CLI.
+
+All fast (`not slow`): the decision cores (FairScheduler, JobPlane,
+JobRegistry) are pure-state and tested without sockets; the end-to-end
+tests reuse the tests/test_fleet.py loopback harness (coordinator +
+member servers in-thread, 32px batches).
+"""
+
+import itertools
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lance_distributed_training_tpu.data import ImageClassificationDecoder
+from lance_distributed_training_tpu.data.pipeline import make_train_pipeline
+from lance_distributed_training_tpu.fleet import (
+    Coordinator,
+    CoordinatorConfig,
+    FleetLoader,
+)
+from lance_distributed_training_tpu.fleet.chaos import ChaosController
+from lance_distributed_training_tpu.fleet.jobs import (
+    DEFAULT_JOB_ID,
+    AdmissionRefused,
+    FairScheduler,
+    JobPlane,
+    JobRegistry,
+    job_slug,
+)
+from lance_distributed_training_tpu.obs.registry import MetricsRegistry
+from lance_distributed_training_tpu.service import (
+    DataService,
+    RemoteLoader,
+    ServeConfig,
+)
+from lance_distributed_training_tpu.service import protocol as P
+
+STEPS = 240 // 16  # image_dataset rows / batch size
+
+
+# -- harness (the tests/test_fleet.py loopback idiom) -----------------------
+
+
+@pytest.fixture()
+def coordinator():
+    coord = Coordinator(CoordinatorConfig(
+        host="127.0.0.1", port=0,
+        heartbeat_interval_s=0.1, lease_ttl_s=0.6,
+    )).start()
+    yield coord
+    coord.stop()
+
+
+def _member(image_dataset, coordinator, **kw):
+    svc = DataService(ServeConfig(
+        dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+        image_size=32, queue_depth=2,
+        coordinator_addr=f"127.0.0.1:{coordinator.port}",
+        **kw,
+    )).start()
+    assert svc.fleet_agent.registered.wait(5), "registration timed out"
+    return svc
+
+
+@pytest.fixture()
+def fleet(image_dataset, coordinator):
+    servers = [_member(image_dataset, coordinator) for _ in range(2)]
+    yield coordinator, servers
+    for s in servers:
+        s.stop()
+
+
+def _fleet_loader(coordinator, **kw):
+    kw.setdefault("connect_retries", 2)
+    kw.setdefault("resolve_retries", 3)
+    kw.setdefault("backoff_s", 0.05)
+    return FleetLoader(f"127.0.0.1:{coordinator.port}", 16, 0, 1, **kw)
+
+
+def _local_batches(image_dataset):
+    return list(make_train_pipeline(
+        image_dataset, "batch", 16, 0, 1,
+        ImageClassificationDecoder(image_size=32),
+    ))
+
+
+def _assert_stream_identical(got, ref):
+    assert len(got) == len(ref), (len(got), len(ref))
+    for i, (a, b) in enumerate(zip(got, ref)):
+        np.testing.assert_array_equal(a["image"], b["image"],
+                                      err_msg=f"step {i}")
+        np.testing.assert_array_equal(a["label"], b["label"],
+                                      err_msg=f"step {i}")
+
+
+def _standalone(image_dataset, **kw):
+    return DataService(ServeConfig(
+        dataset_path=image_dataset.uri, host="127.0.0.1", port=0,
+        image_size=32, queue_depth=2, **kw,
+    )).start()
+
+
+def _raw_hello(port, **fields):
+    """One raw HELLO → (msg_type, reply, sock). Caller closes the sock."""
+    sock = socket.create_connection(("127.0.0.1", port))
+    try:
+        P.send_msg(sock, P.MSG_HELLO, P.hello(
+            batch_size=16, process_index=0, process_count=1, **fields,
+        ))
+        msg_type, reply = P.recv_msg(sock)
+        return msg_type, reply, sock
+    except BaseException:
+        sock.close()
+        raise
+
+
+# -- FairScheduler: the pure stride-scheduling core -------------------------
+
+
+def test_fair_scheduler_weighted_share():
+    """2:1 weights (training vs bulk) → exactly 2:1 granted steps."""
+    s = FairScheduler()
+    s.ensure("a", "training")  # weight 2.0
+    s.ensure("b", "bulk")      # weight 1.0
+    grants = {"a": 0, "b": 0}
+    for _ in range(30):
+        job = s.pick(["a", "b"])
+        grants[job] += 1
+        s.advance(job)
+    assert grants == {"a": 20, "b": 10}
+
+
+def test_fair_scheduler_preempt_class_first():
+    """An inference job goes first regardless of its accumulated pass —
+    a single-batch probe never queues behind a bulk scan."""
+    s = FairScheduler()
+    s.ensure("scan", "bulk")
+    s.ensure("probe", "inference")
+    for _ in range(8):  # bank pass AGAINST the probe
+        s.advance("probe")
+    assert s.pick(["scan", "probe"]) == "probe"
+
+
+def test_fair_scheduler_late_joiner_no_burst():
+    """A job joining mid-stream starts at the incumbents' pass: no
+    catch-up burst, no starvation — equal weights settle to ~50/50."""
+    s = FairScheduler()
+    s.ensure("old", "training")
+    for _ in range(10):
+        s.advance("old")
+    s.ensure("new", "training")
+    grants = {"old": 0, "new": 0}
+    for _ in range(12):
+        job = s.pick(["old", "new"])
+        grants[job] += 1
+        s.advance(job)
+    assert grants == {"old": 6, "new": 6}
+
+
+def test_fair_scheduler_begin_step_is_bounded():
+    """A contending tenant that never takes its turn degrades fairness,
+    never liveness: begin_step returns within ~max_wait_s."""
+    s = FairScheduler(max_wait_s=0.2)
+    # A phantom preempting job sits "waiting" forever without advancing.
+    with s._cond:
+        s._ensure_locked("phantom", "inference")
+        s._waiting["phantom"] = 1
+    t0 = time.monotonic()
+    s.begin_step("mine")
+    assert time.monotonic() - t0 < 2.0  # bounded, not wedged
+    # And with no contention at all, the fast path is immediate.
+    solo = FairScheduler(max_wait_s=5.0)
+    t0 = time.monotonic()
+    solo.begin_step("mine")
+    assert time.monotonic() - t0 < 0.5
+
+
+# -- slugs -------------------------------------------------------------------
+
+
+def test_job_slug_sanitizes():
+    assert job_slug("smoke-train") == "smoke_train"
+    assert job_slug("Tenant.A") == "tenant_a"
+    assert job_slug("--") == "job"  # never empty
+
+
+def test_job_plane_slug_collision_disambiguated():
+    plane = JobPlane(registry=MetricsRegistry(), slo_interval_s=60.0)
+    try:
+        plane.admit("a-b", "training", "s1")
+        plane.admit("a.b", "training", "s2")
+        with plane._lock:
+            slugs = {j: st.slug for j, st in plane._jobs.items()}
+        assert slugs["a-b"] == "a_b"
+        assert slugs["a.b"].startswith("a_b_") and slugs["a.b"] != "a_b"
+    finally:
+        plane.stop()
+
+
+# -- JobPlane: admission gates ----------------------------------------------
+
+
+def test_job_plane_admission_gates():
+    from lance_distributed_training_tpu.utils.metrics import ServiceCounters
+
+    reg = MetricsRegistry()
+    counters = ServiceCounters(registry=reg)
+    plane = JobPlane(counters=counters, registry=reg, max_jobs=1,
+                     slo_interval_s=60.0)
+    try:
+        plane.admit("tenant-a", "training", "sess-1")
+        # Capacity: one non-read-only slot, taken.
+        with pytest.raises(AdmissionRefused) as exc:
+            plane.admit("tenant-b", "training", "sess-2")
+        assert str(exc.value).startswith(P.ADMISSION_REFUSED_MARKER)
+        assert "job capacity reached" in str(exc.value)
+        # Reconnect of an ADMITTED job is never refused (failover safety).
+        plane.admit("tenant-a", "training", "sess-3")
+        # read_only (inference) is exempt from the capacity cap.
+        plane.admit("probe", "inference", "sess-4")
+        # Priority skew across one job's clients is refused.
+        with pytest.raises(AdmissionRefused) as exc:
+            plane.admit("tenant-a", "bulk", "sess-5")
+        assert "priority skew" in str(exc.value)
+        # Unknown class is refused, not silently defaulted.
+        with pytest.raises(AdmissionRefused) as exc:
+            plane.admit("tenant-c", "urgent", "sess-6")
+        assert "unknown priority class" in str(exc.value)
+        snap = counters.snapshot()
+        assert snap["svc_admission_refusals"] == 3
+        assert snap["svc_jobs_active"] == 2  # tenant-a + probe
+    finally:
+        plane.stop()
+
+
+def test_job_plane_stall_slo_gate():
+    stall = {"pct": 80.0}
+    plane = JobPlane(registry=MetricsRegistry(), max_stall_pct=25.0,
+                     stall_fn=lambda: stall["pct"], slo_interval_s=60.0)
+    try:
+        with pytest.raises(AdmissionRefused) as exc:
+            plane.admit("newcomer", "training", "s1")
+        message = str(exc.value)
+        assert message.startswith(P.ADMISSION_REFUSED_MARKER)
+        assert "80.0% exceeds the admission ceiling 25.0%" in message
+        # Once the fleet calms down the same job is admitted...
+        stall["pct"] = 3.0
+        plane.admit("newcomer", "training", "s1")
+        # ...and a RE-connect passes even during a later stall storm.
+        stall["pct"] = 99.0
+        plane.admit("newcomer", "training", "s2")
+    finally:
+        plane.stop()
+
+
+def test_job_plane_broken_stall_probe_does_not_gate():
+    def boom():
+        raise RuntimeError("probe broken")
+
+    plane = JobPlane(registry=MetricsRegistry(), max_stall_pct=25.0,
+                     stall_fn=boom, slo_interval_s=60.0)
+    try:
+        plane.admit("tenant", "training", "s1")  # must not raise
+    finally:
+        plane.stop()
+
+
+# -- JobPlane: cursors, cache accounting, stats ------------------------------
+
+
+def test_job_plane_cursors_and_cache_accounting():
+    plane = JobPlane(registry=MetricsRegistry(), slo_interval_s=60.0)
+    try:
+        plane.admit("tenant-a", "training", "s1")
+        # Cursor is the max acked step per client, monotonic.
+        plane.note_cursor("tenant-a", "c1", 5)
+        plane.note_cursor("tenant-a", "c1", 3)   # stale ACK: ignored
+        plane.note_cursor("tenant-a", "c2", 7)
+        plane.note_cache("tenant-a", True)
+        plane.note_cache("tenant-a", True)
+        plane.note_cache("tenant-a", False)
+        plane.note_plan("tenant-a", ("plan", "key"))
+        # Unknown jobs are silently ignored on every hot-path hook.
+        plane.note_cursor("ghost", "c1", 99)
+        plane.note_cache("ghost", True)
+        assert plane.counters_for("ghost") is None
+        stats = plane.stats()
+        row = stats["tenant-a"]
+        assert row["priority"] == "training"
+        assert row["sessions"] == 1
+        assert row["cursor"] == 7
+        assert row["cache_hit"] == 2.0 and row["cache_miss"] == 1.0
+        assert row["plans"] == [str(("plan", "key"))]
+        # A session ending keeps the tenant's state (reconnects resume).
+        plane.release("tenant-a", "s1")
+        row = plane.stats()["tenant-a"]
+        assert row["sessions"] == 0 and row["cursor"] == 7
+    finally:
+        plane.stop()
+
+
+# -- JobRegistry: the coordinator-side aggregate ------------------------------
+
+
+def test_job_registry_aggregates_members():
+    reg = JobRegistry()
+    reg.declare("tenant-a", "training")
+    reg.declare("tenant-a")  # idempotent, keeps the declared class
+    reg.observe_member("m1", {
+        "tenant-a": {"priority": "training", "sessions": 1, "cursor": 4,
+                     "batches_sent": 5.0, "cache_hit": 3.0,
+                     "cache_miss": 1.0,
+                     "slo": {"stall_pct": {"burn": {"1m": 0.5}}}},
+    })
+    reg.observe_member("m2", {
+        "tenant-a": {"priority": "training", "sessions": 2, "cursor": 9,
+                     "batches_sent": 10.0, "cache_hit": 1.0,
+                     "cache_miss": 3.0,
+                     "slo": {"stall_pct": {"burn": {"1m": 2.0}}}},
+        "tenant-b": {"priority": "bulk", "sessions": 1, "cursor": 2},
+    })
+    rows = {r["job_id"]: r for r in reg.payload()}
+    assert set(rows) == {"tenant-a", "tenant-b"}
+    a = rows["tenant-a"]
+    assert a["sessions"] == 3          # summed across members
+    assert a["cursor"] == 9            # maxed across members
+    assert a["cache_hit_rate"] == 0.5  # (3+1) / (3+1+1+3)
+    assert a["slo_burn"]["stall_pct"]["1m"] == 2.0  # worst-of
+    assert rows["tenant-b"]["priority"] == "bulk"  # learned from heartbeat
+
+
+def test_job_registry_cursor_survives_member_loss():
+    reg = JobRegistry()
+    reg.observe_member("m1", {"tenant-a": {"cursor": 11, "sessions": 1}})
+    reg.drop_member("m1")  # expiry or deregister
+    rows = {r["job_id"]: r for r in reg.payload()}
+    assert rows["tenant-a"]["cursor"] == 11  # the registry remembers
+    assert rows["tenant-a"]["sessions"] == 0  # live stats are gone
+
+
+def test_job_registry_ignores_malformed():
+    reg = JobRegistry()
+    reg.declare(None)
+    reg.declare(123)
+    reg.observe_member("m1", "garbage")
+    reg.observe_member("m2", {"ok": {"cursor": "NaN"}, 3: {}, "x": []})
+    rows = {r["job_id"]: r for r in reg.payload()}
+    assert set(rows) == {"ok"}
+    assert rows["ok"]["cursor"] == -1  # the garbage cursor never landed
+
+
+# -- admission + tenancy on the wire (end-to-end HELLO) ----------------------
+
+
+def test_hello_admission_refused_end_to_end(image_dataset):
+    """One non-read-only slot: job A streams, job B gets a diagnosable
+    MSG_ERROR, A's reconnect still succeeds, an inference probe bypasses
+    the cap."""
+    svc = _standalone(image_dataset, admission_max_jobs=1)
+    try:
+        msg_type, reply, sock = _raw_hello(
+            svc.port, job_id="job-a", job_priority="training")
+        sock.close()
+        assert msg_type == P.MSG_HELLO_OK
+        assert reply["job_id"] == "job-a"  # v6 echo (tenancy receipt)
+        # Second tenant: refused with the frozen marker prose.
+        msg_type, reply, sock = _raw_hello(
+            svc.port, job_id="job-b", job_priority="training")
+        sock.close()
+        assert msg_type == P.MSG_ERROR
+        assert reply["message"].startswith(P.ADMISSION_REFUSED_MARKER)
+        assert "job capacity reached (1/1" in reply["message"]
+        # Admitted jobs are never refused: A reconnects fine.
+        msg_type, reply, sock = _raw_hello(
+            svc.port, job_id="job-a", job_priority="training")
+        sock.close()
+        assert msg_type == P.MSG_HELLO_OK
+        # read_only inference probe is exempt from the cap.
+        msg_type, reply, sock = _raw_hello(
+            svc.port, job_id="probe", job_priority="inference")
+        sock.close()
+        assert msg_type == P.MSG_HELLO_OK and reply["job_id"] == "probe"
+        assert svc.counters.snapshot()["svc_admission_refusals"] >= 1
+        assert set(svc.job_plane.stats()) == {"job-a", "probe"}
+    finally:
+        svc.stop()
+
+
+def test_v5_peer_maps_to_implicit_default_job(image_dataset):
+    """Downgrade safety: a v5 HELLO (no job fields on the wire) becomes
+    the implicit default job — same behavior as pre-v6, and its HELLO_OK
+    carries no job echo (the reply stays byte-compatible)."""
+    svc = _standalone(image_dataset)
+    try:
+        msg_type, reply, sock = _raw_hello(svc.port, version=5)
+        sock.close()
+        assert msg_type == P.MSG_HELLO_OK
+        assert "job_id" not in reply
+        assert DEFAULT_JOB_ID in svc.job_plane.stats()
+        # A v6 peer that declares nothing lands on the same tenant,
+        # and DOES get the echo (it speaks the job plane).
+        msg_type, reply, sock = _raw_hello(svc.port)
+        sock.close()
+        assert msg_type == P.MSG_HELLO_OK
+        assert reply["job_id"] == DEFAULT_JOB_ID
+        assert set(svc.job_plane.stats()) == {DEFAULT_JOB_ID}
+    finally:
+        svc.stop()
+
+
+def test_explicit_job_refuses_pre_v6_server():
+    """An explicit job_id is NOT downgrade-safe: against a server whose
+    HELLO_OK says v5, the client refuses instead of silently streaming
+    as the anonymous default tenant. Undeclared loaders still work."""
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(4)
+    port = listener.getsockname()[1]
+    stop = threading.Event()
+
+    def fake_v5_server():
+        while not stop.is_set():
+            try:
+                conn, _ = listener.accept()
+            except OSError:
+                return
+            try:
+                msg_type, req = P.recv_msg(conn)
+                P.send_msg(conn, P.MSG_HELLO_OK, {
+                    "version": 5, "num_steps": 5,
+                    "start_step": int(req.get("start_step", 0)),
+                })
+                P.send_msg(conn, P.MSG_END, {})
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    thread = threading.Thread(target=fake_v5_server, daemon=True)
+    thread.start()
+    try:
+        loader = RemoteLoader(f"127.0.0.1:{port}", 16, 0, 1,
+                              job_id="tenant-a", connect_retries=1)
+        with pytest.raises(P.ProtocolError, match="no job plane"):
+            len(loader)
+        # No declared job: the same server is perfectly serviceable.
+        assert len(RemoteLoader(f"127.0.0.1:{port}", 16, 0, 1,
+                                connect_retries=1)) == 5
+    finally:
+        stop.set()
+        listener.close()
+        thread.join(timeout=5)
+
+
+# -- two jobs, one fleet ------------------------------------------------------
+
+
+def test_two_jobs_disjoint_cursors_on_registry(image_dataset, fleet):
+    """Two tenants share the fleet; each gets its own resume cursor on
+    the coordinator (job A a full 1-shard epoch, job B a 2-shard slice),
+    aggregated from member heartbeats."""
+    coordinator, _ = fleet
+    ref = _local_batches(image_dataset)
+    loader_a = _fleet_loader(coordinator, job_id="tenant-a",
+                             job_priority="training")
+    _assert_stream_identical(list(loader_a), ref)
+    loader_b = FleetLoader(
+        f"127.0.0.1:{coordinator.port}", 16, 0, 2,
+        connect_retries=2, resolve_retries=3, backoff_s=0.05,
+        job_id="tenant-b", job_priority="bulk",
+    )
+    steps_b = len(list(loader_b))
+    assert 0 < steps_b < STEPS  # a 2-shard slice is strictly shorter
+    # Cursors are OBSERVED acks — the very last steps' acks can go
+    # unread when the session closes right after MSG_END, so the cursor
+    # may trail the final step by a frame or two. Near-end is the
+    # contract (a resume from it re-streams at most that tail).
+    deadline = time.monotonic() + 5.0
+    rows = {}
+    while time.monotonic() < deadline:
+        rows = {r["job_id"]: r for r in coordinator.jobs.payload()}
+        a, b = rows.get("tenant-a"), rows.get("tenant-b")
+        if a and b and a["cursor"] >= STEPS - 3 \
+                and b["cursor"] >= steps_b - 3:
+            break
+        time.sleep(0.05)
+    assert STEPS - 3 <= rows["tenant-a"]["cursor"] <= STEPS - 1
+    assert steps_b - 3 <= rows["tenant-b"]["cursor"] <= steps_b - 1
+    assert rows["tenant-a"]["cursor"] > rows["tenant-b"]["cursor"]
+    assert rows["tenant-a"]["priority"] == "training"
+    assert rows["tenant-b"]["priority"] == "bulk"
+    # The same rows ride MSG_FLEET_RESOLVE for `ldt jobs` / fleet CLIs.
+    _, payload = coordinator._handle_resolve({})
+    assert {r["job_id"] for r in payload["jobs"]} >= {"tenant-a",
+                                                      "tenant-b"}
+
+
+def test_two_jobs_concurrent_streams_bit_identical_with_kill(
+        image_dataset, fleet):
+    """Acceptance: two jobs stream concurrently while a member dies
+    mid-epoch — BOTH per-job streams stay bit-identical to the local
+    pipeline (fairness paces, never reorders or corrupts)."""
+    coordinator, servers = fleet
+    ref = _local_batches(image_dataset)
+    chaos = ChaosController(servers[0]).kill_after(3)
+    results, errors = {}, []
+
+    def run(job_id, priority):
+        try:
+            loader = _fleet_loader(coordinator, job_id=job_id,
+                                   job_priority=priority)
+            results[job_id] = list(loader)
+        except Exception as exc:  # noqa: BLE001 — surfaced below
+            errors.append((job_id, exc))
+
+    threads = [
+        threading.Thread(target=run, args=("tenant-a", "training")),
+        threading.Thread(target=run, args=("tenant-b", "bulk")),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert chaos.killed.is_set()
+    _assert_stream_identical(results["tenant-a"], ref)
+    _assert_stream_identical(results["tenant-b"], ref)
+
+
+def test_cross_job_cache_hits(image_dataset, coordinator):
+    """The PR-13 content-keyed batch cache is cross-job by construction:
+    a second tenant with the SAME decode config streams cache hits; a
+    tenant with a DIFFERENT plan gets none (content keys, not job keys)."""
+    servers = [_member(image_dataset, coordinator, batch_cache=True)
+               for _ in range(2)]
+    try:
+        ref = _local_batches(image_dataset)
+        warm = _fleet_loader(coordinator, job_id="tenant-a",
+                             job_priority="training")
+        _assert_stream_identical(list(warm), ref)
+        same = _fleet_loader(coordinator, job_id="tenant-b",
+                             job_priority="training")
+        _assert_stream_identical(list(same), ref)  # hits don't change bytes
+
+        def job_totals(job_id, key):
+            return sum(
+                s.job_plane.stats().get(job_id, {}).get(key, 0.0)
+                for s in servers
+            )
+
+        assert job_totals("tenant-b", "cache_hit") > 0
+        # A different batch geometry produces different plan items —
+        # content keys share NOTHING with the warm epoch. (A merely
+        # re-ORDERED plan would still hit: the keys are content, not
+        # job or order — that's the point.)
+        other = FleetLoader(
+            f"127.0.0.1:{coordinator.port}", 8, 0, 1,
+            connect_retries=2, resolve_retries=3, backoff_s=0.05,
+            job_id="tenant-c", job_priority="training",
+        )
+        assert len(list(other)) == 240 // 8
+        assert job_totals("tenant-c", "cache_hit") == 0
+        assert job_totals("tenant-c", "cache_miss") > 0
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_inference_probe_streams_alongside_bulk(image_dataset):
+    """A read-only inference probe admitted next to a bulk scan on one
+    server: both complete, per-job scopes split the accounting, and the
+    probe's preempting class is live in the scheduler."""
+    svc = _standalone(image_dataset)
+    try:
+        addr = f"127.0.0.1:{svc.port}"
+        done = {}
+
+        def scan():
+            loader = RemoteLoader(addr, 16, 0, 1, job_id="bulk-scan",
+                                  job_priority="bulk", connect_retries=2)
+            done["bulk-scan"] = len(list(loader))
+
+        thread = threading.Thread(target=scan)
+        thread.start()
+        probe = RemoteLoader(addr, 16, 0, 1, job_id="probe",
+                             job_priority="inference", connect_retries=2)
+        first = list(itertools.islice(iter(probe), 1))
+        assert len(first) == 1 and first[0]["image"].shape[0] == 16
+        thread.join(timeout=120)
+        assert done["bulk-scan"] == STEPS
+        stats = svc.job_plane.stats()
+        assert stats["probe"]["priority"] == "inference"
+        assert stats["bulk-scan"]["priority"] == "bulk"
+        assert stats["bulk-scan"]["batches_sent"] >= STEPS
+        assert svc.job_plane.scheduler._preempt["probe"] is True
+        # The per-job scopes land on the shared registry (the /metrics
+        # surface) under the svc_job_<slug>_ prefix.
+        reg = svc.counters.registry
+        # Observed-ack cursor: trailing acks can go unread at close.
+        assert reg.gauge("svc_job_bulk_scan_cursor").value >= STEPS - 3
+        assert reg.counter("svc_job_probe_batches_sent").value >= 1
+        # /healthz carries the same per-tenant rows.
+        health = svc._healthz()
+        assert set(health["jobs"]) == {"bulk-scan", "probe"}
+    finally:
+        svc.stop()
+
+
+# -- stale pressure on expiry (the r20 coordinator fix) ----------------------
+
+
+def _coordinator(**kw):
+    return Coordinator(
+        CoordinatorConfig(host="127.0.0.1", port=0, **kw),
+        registry=MetricsRegistry(),
+    )
+
+
+def test_expired_member_pressure_withholds_drain():
+    """Heartbeat expiry used to silently drop the member's pressure
+    history; the survivors' calm then flipped the recommendation to
+    drain_candidate on the very blip that shrank the fleet. The last
+    window is now retained (tagged stale) and blocks the drain."""
+    coord = _coordinator(lease_ttl_s=0.3, heartbeat_interval_s=0.1,
+                         scale_down_stall_pct=5.0).start()
+    try:
+        for i, sid in enumerate(("hot", "calm1", "calm2")):
+            coord._handle_register({"server_id": sid, "addr": f"h:{i + 1}",
+                                    "num_fragments": 6})
+        coord._handle_heartbeat({"server_id": "hot", "pressure": {
+            "stall_pct": 42.0, "active_clients": 2,
+        }})
+        # Keep the calm members alive while "hot" goes silent past TTL.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            for sid in ("calm1", "calm2"):
+                coord._handle_heartbeat({"server_id": sid, "pressure": {
+                    "stall_pct": 1.0, "active_clients": 1,
+                }})
+            with coord._lock:
+                if "hot" not in coord._members:
+                    break
+            time.sleep(0.05)
+        with coord._lock:
+            assert "hot" not in coord._members  # expired, not deregistered
+        _, payload = coord._handle_resolve({})
+        rec = payload["recommendation"]
+        assert rec["action"] == "ok"
+        assert "drain withheld" in rec["reason"] and "hot" in rec["reason"]
+        stale = {e["server_id"]: e for e in payload["stale_members"]}
+        assert stale["hot"]["pressure"]["stall_pct"] == 42.0
+        assert stale["hot"]["pressure"]["stale"] is True
+        assert stale["hot"]["stale_age_s"] >= 0
+        # Re-registration supersedes the stale window: once "hot" is back
+        # and calm, the drain recommendation is allowed again.
+        coord._handle_register({"server_id": "hot", "addr": "h:1",
+                                "num_fragments": 6})
+        coord._handle_heartbeat({"server_id": "hot", "pressure": {
+            "stall_pct": 1.0, "active_clients": 1,
+        }})
+        _, payload = coord._handle_resolve({})
+        assert payload["recommendation"]["action"] == "drain_candidate"
+        assert payload["stale_members"] == []
+    finally:
+        coord.stop()
+
+
+def test_graceful_deregister_leaves_no_stale_pressure():
+    """A graceful leave is evidence, not a blip: the departing member's
+    pressure must NOT haunt the recommendation."""
+    coord = _coordinator(scale_down_stall_pct=5.0)
+    for i, sid in enumerate(("leaver", "calm1", "calm2")):
+        coord._handle_register({"server_id": sid, "addr": f"h:{i + 1}",
+                                "num_fragments": 6})
+    coord._handle_heartbeat({"server_id": "leaver", "pressure": {
+        "stall_pct": 42.0, "active_clients": 2,
+    }})
+    for sid in ("calm1", "calm2"):
+        coord._handle_heartbeat({"server_id": sid, "pressure": {
+            "stall_pct": 1.0, "active_clients": 1,
+        }})
+    coord._handle_deregister({"server_id": "leaver"})
+    _, payload = coord._handle_resolve({})
+    assert payload["stale_members"] == []
+    assert payload["recommendation"]["action"] == "drain_candidate"
+
+
+def test_fleet_cli_shows_expired_member_and_jobs(capsys):
+    """`ldt fleet recommend` surfaces the stale-member row and the
+    per-job table (the operator-facing half of both r20 changes)."""
+    from lance_distributed_training_tpu.cli import fleet_main
+
+    coord = _coordinator(lease_ttl_s=0.2, heartbeat_interval_s=0.1).start()
+    try:
+        coord._handle_register({"server_id": "ghost", "addr": "h:1",
+                                "num_fragments": 4})
+        coord._handle_heartbeat({"server_id": "ghost", "pressure": {
+            "stall_pct": 33.0, "active_clients": 1,
+        }})
+        coord.jobs.declare("tenant-a", "training")
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with coord._lock:
+                if "ghost" not in coord._members:
+                    break
+            time.sleep(0.05)
+        rc = fleet_main(["recommend", "--coordinator",
+                         f"127.0.0.1:{coord.port}"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "ghost EXPIRED" in out and "last stall 33.0%" in out
+        assert "tenant-a [training]" in out
+    finally:
+        coord.stop()
+
+
+# -- `ldt jobs` (the operator CLI) -------------------------------------------
+
+
+def test_jobs_cli_list_describe_json(capsys):
+    from lance_distributed_training_tpu.cli import jobs_main, main
+
+    coord = _coordinator().start()
+    try:
+        addr = f"127.0.0.1:{coord.port}"
+        coord.jobs.declare("tenant-a", "training")
+        coord.jobs.observe_member("m1", {
+            "tenant-a": {"priority": "training", "sessions": 2,
+                         "cursor": 14, "batches_sent": 30.0,
+                         "cache_hit": 3.0, "cache_miss": 1.0,
+                         "slo": {"stall_pct": {"burn": {"1m": 0.5}}}},
+        })
+        rc = jobs_main(["list", "--coordinator", addr])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "1 job(s)" in out
+        assert "tenant-a [training]" in out
+        assert "cursor 14" in out and "cache_hit_rate 0.75" in out
+        # JSON mode is the raw rows (scripting surface).
+        rc = jobs_main(["list", "--coordinator", addr, "--json"])
+        rows = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert rows[0]["job_id"] == "tenant-a"
+        assert rows[0]["slo_burn"]["stall_pct"]["1m"] == 0.5
+        # describe: full detail including per-objective burn windows.
+        rc = jobs_main(["describe", "tenant-a", "--coordinator", addr])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "priority:       training" in out
+        assert "resume cursor:  14" in out
+        assert "cache hit rate: 0.75 (hit 3.0 / miss 1.0)" in out
+        assert "slo stall_pct: burn 1m=0.5" in out
+        # Unknown tenant: distinct exit status for scripting.
+        rc = jobs_main(["describe", "nobody", "--coordinator", addr])
+        assert rc == 4
+        assert "not registered" in capsys.readouterr().out
+        # describe without a job_id is a usage error.
+        with pytest.raises(SystemExit):
+            jobs_main(["describe", "--coordinator", addr])
+        capsys.readouterr()
+        # Top-level dispatch: `ldt jobs ...` routes here.
+        rc = main(["jobs", "list", "--coordinator", addr])
+        assert rc == 0 and "tenant-a" in capsys.readouterr().out
+    finally:
+        coord.stop()

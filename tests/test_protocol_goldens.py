@@ -36,7 +36,7 @@ def test_checked_in_corpus_round_trips():
 
 def test_corpus_covers_every_version_and_wire_message():
     versions = {s.version for s in G.GOLDEN_SPECS}
-    assert versions == {1, 2, 3, 4, 5}
+    assert versions == {1, 2, 3, 4, 5, 6}
     covered = {s.msg for s in G.GOLDEN_SPECS}
     wire_msgs = {n for n in dir(P) if n.startswith("MSG_")}
     assert covered == wire_msgs, (
@@ -90,6 +90,36 @@ def test_version_mismatch_marker_is_pinned_by_a_golden():
     _type, payload = G._split_frame(data)
     msg = json.loads(bytes(payload))
     assert P.VERSION_MISMATCH_MARKER in msg["message"]
+
+
+def test_admission_refused_marker_is_pinned_by_a_golden():
+    """Rewording ADMISSION_REFUSED_MARKER breaks this golden before it
+    breaks every client/operator keying a refusal off the prefix."""
+    data = (GOLDEN_DIR / "v6_error_admission_refused.bin").read_bytes()
+    _type, payload = G._split_frame(data)
+    msg = json.loads(bytes(payload))
+    assert msg["message"].startswith(P.ADMISSION_REFUSED_MARKER)
+
+
+def test_v6_hello_goldens_pin_job_field_gating():
+    """The byte-identity rule of the v6 job plane: job keys ALWAYS
+    present (null when undeclared) at v6+, ABSENT below v6 — so every
+    v1-v5 golden regenerates byte-identically forever."""
+    data = (GOLDEN_DIR / "v6_hello_full.bin").read_bytes()
+    _type, payload = G._split_frame(data)
+    msg = json.loads(bytes(payload))
+    assert msg["version"] == 6
+    assert msg["job_id"] is None and msg["job_priority"] is None
+    data = (GOLDEN_DIR / "v6_hello_job.bin").read_bytes()
+    _type, payload = G._split_frame(data)
+    msg = json.loads(bytes(payload))
+    assert msg["job_id"] == "tenant-a"
+    assert msg["job_priority"] == "inference"
+    for name in ("v5_hello_full", "v4_hello_full", "v3_hello_full"):
+        data = (GOLDEN_DIR / f"{name}.bin").read_bytes()
+        _type, payload = G._split_frame(data)
+        msg = json.loads(bytes(payload))
+        assert "job_id" not in msg and "job_priority" not in msg, name
 
 
 # -- corruption / drift detection --------------------------------------------
@@ -176,6 +206,13 @@ def test_golden_hellos_accepted_by_live_server(image_dataset):
                 "stripe_index": 1, "stripe_count": 4,
             }),
             ("v3_hello_fingerprint", None),  # fingerprint skew: rejected
+            # v5 peer with no job fields: implicitly the default tenant
+            # (mixed-version interop — the v6 server must not refuse it).
+            ("v5_hello_full", {"version": 5}),
+            # v6 default HELLO (job keys null): echoed as "default".
+            ("v6_hello_full", {"version": 6, "job_id": "default"}),
+            # v6 explicit job + inference class: admitted and echoed.
+            ("v6_hello_job", {"version": 6, "job_id": "tenant-a"}),
         ):
             data = (GOLDEN_DIR / f"{name}.bin").read_bytes()
             sock = socket.create_connection(
